@@ -1,0 +1,28 @@
+"""jepsen_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+A ground-up rebuild of the capabilities of Jepsen (reference:
+m1l4n54v1c/jepsen, Clojure/JVM): it sets up a real distributed system over an
+SSH control plane, drives randomized concurrent operations from a
+pure-functional generator while a nemesis injects faults, records a complete
+operation history, and verifies that history against consistency models.
+
+The defining difference: the compute-bound checking stage — Knossos-class
+linearizability search and Elle-class transactional-cycle detection — runs as
+JAX/XLA kernels on TPU. Histories are encoded as structure-of-arrays device
+tensors; the linearizability search is a breadth-first frontier over
+fixed-width configurations (`lax.while_loop` + sort-dedup), vmapped over
+independent keys and sharded across a `jax.sharding.Mesh` with psum-OR
+verdict reduction.
+
+Layer map (mirrors reference SURVEY.md §1):
+  L0 control/       — remote execution (SSH/docker/k8s), shell escaping
+  L1 os*/db         — environment automation protocols
+  L2 nemesis*/net   — fault injection
+  L3 generator/     — pure-functional op scheduler + combinators
+  L4 core/client    — orchestrator runtime
+  L5 checker/       — analysis (the TPU compute core)
+  L6 store/web/cli  — persistence, reporting, UI
+  L7 workloads + suites — per-database test bundles
+"""
+
+__version__ = "0.1.0"

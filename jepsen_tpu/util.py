@@ -1,0 +1,273 @@
+"""Kitchen-sink utilities shared across layers.
+
+Behavioral parity targets from the reference's `jepsen/src/jepsen/util.clj`:
+`real-pmap` (crash-safe parallel map, :65), `with-relative-time` /
+`relative-time-nanos` (:333-347), `timeout` (:370), `await-fn` (:383),
+`nemesis-intervals` (:736), `history->latencies` (:700),
+`integer-interval-set-str` (:629), `named-locks` (:860).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import math
+import threading
+import time as _time
+from typing import Any, Callable, Iterable, Sequence
+
+
+# ---------------------------------------------------------------------------
+# Relative time
+# ---------------------------------------------------------------------------
+
+_relative_origin = threading.local()
+_GLOBAL_ORIGIN: list[int | None] = [None]
+
+
+class relative_time:
+    """Context manager establishing t=0 for a test run; all op :time fields
+    are nanoseconds since this origin (reference util.clj:333-347)."""
+
+    def __enter__(self):
+        _GLOBAL_ORIGIN[0] = _time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        _GLOBAL_ORIGIN[0] = None
+        return False
+
+
+def relative_time_nanos() -> int:
+    origin = _GLOBAL_ORIGIN[0]
+    if origin is None:
+        raise RuntimeError("relative_time_nanos called outside relative_time")
+    return _time.monotonic_ns() - origin
+
+
+def ms_to_nanos(ms: float) -> int:
+    return int(ms * 1_000_000)
+
+
+def nanos_to_ms(ns: float) -> float:
+    return ns / 1_000_000
+
+
+def secs_to_nanos(s: float) -> int:
+    return int(s * 1_000_000_000)
+
+
+def nanos_to_secs(ns: float) -> float:
+    return ns / 1_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# Parallelism
+# ---------------------------------------------------------------------------
+
+def real_pmap(fn: Callable, coll: Iterable) -> list:
+    """Parallel map over real threads, one per element. If any element's fn
+    throws, the first exception propagates (after all threads finish or are
+    cancelled) — mirrors reference real-pmap's crash behavior."""
+    items = list(coll)
+    if not items:
+        return []
+    if len(items) == 1:
+        return [fn(items[0])]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=len(items)) as ex:
+        futures = [ex.submit(fn, x) for x in items]
+        results, first_exc = [], None
+        for fut in futures:
+            try:
+                results.append(fut.result())
+            except BaseException as e:  # noqa: BLE001 — propagate any crash
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+        return results
+
+
+def bounded_pmap(fn: Callable, coll: Iterable, max_workers: int = 16) -> list:
+    """Parallel map with bounded concurrency, preserving order."""
+    items = list(coll)
+    if not items:
+        return []
+    workers = max(1, min(max_workers, len(items)))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        return list(ex.map(fn, items))
+
+
+class Timeout(Exception):
+    pass
+
+
+def timeout(seconds: float, fn: Callable[[], Any],
+            default: Any = Timeout) -> Any:
+    """Run fn in a worker thread; if it exceeds the deadline return
+    ``default`` (or raise Timeout when no default given). The worker is
+    abandoned, not killed — Python threads can't be interrupted safely, so
+    fns should be side-effect-tolerant (reference timeout interrupts,
+    util.clj:370; this is the closest portable semantic)."""
+    box: list = []
+
+    def run():
+        try:
+            box.append(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001
+            box.append(("err", e))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if not box:
+        if default is Timeout:
+            raise Timeout(f"timed out after {seconds}s")
+        return default
+    tag, val = box[0]
+    if tag == "err":
+        raise val
+    return val
+
+
+def await_fn(fn: Callable[[], Any], retry_interval: float = 1.0,
+             timeout_secs: float = 60.0, log_message: str | None = None,
+             log_interval: float | None = 10.0) -> Any:
+    """Invoke fn until it returns without throwing; retry every
+    retry_interval seconds up to timeout_secs (reference util.clj:383-424)."""
+    deadline = _time.monotonic() + timeout_secs
+    last_log = _time.monotonic()
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            now = _time.monotonic()
+            if now >= deadline:
+                raise Timeout(
+                    f"timed out after {timeout_secs}s awaiting "
+                    f"{log_message or fn}") from e
+            if (log_message and log_interval
+                    and now - last_log >= log_interval):
+                print(log_message)
+                last_log = now
+            _time.sleep(min(retry_interval, max(0.0, deadline - now)))
+
+
+class NamedLocks:
+    """A family of locks, one per name (reference util.clj:860)."""
+
+    def __init__(self):
+        self._locks: dict = {}
+        self._guard = threading.Lock()
+
+    def lock(self, name) -> threading.Lock:
+        with self._guard:
+            if name not in self._locks:
+                self._locks[name] = threading.Lock()
+            return self._locks[name]
+
+
+# ---------------------------------------------------------------------------
+# History analysis helpers
+# ---------------------------------------------------------------------------
+
+def history_latencies(hist) -> list[dict]:
+    """Completions annotated with :latency (ns from invoke to completion);
+    pending invocations get no entry (reference util.clj:700)."""
+    from .history import is_invoke, is_client_op
+    open_by_process: dict = {}
+    out = []
+    for o in hist:
+        if not is_client_op(o):
+            continue
+        if is_invoke(o):
+            open_by_process[o["process"]] = o
+        else:
+            inv = open_by_process.pop(o["process"], None)
+            if inv is not None and o.get("time") is not None \
+                    and inv.get("time") is not None:
+                oo = dict(o)
+                oo["latency"] = o["time"] - inv["time"]
+                out.append(oo)
+    return out
+
+
+def nemesis_intervals(hist, start_fs: set | None = None,
+                      stop_fs: set | None = None) -> list[tuple]:
+    """Pairs of (start-op, stop-op-or-None) intervals of nemesis activity
+    (reference util.clj:736). By default every nemesis op alternates
+    start/stop per :f pairing {start-x -> stop-x}; unmatched starts run to
+    the end of history (None)."""
+    from .history import NEMESIS
+    starts: list = []
+    intervals: list[tuple] = []
+    for o in hist:
+        if o.get("process") != NEMESIS or o["type"] != "info":
+            continue
+        f = str(o.get("f", ""))
+        is_start = (start_fs and o["f"] in start_fs) or \
+                   (not start_fs and f.startswith("start"))
+        is_stop = (stop_fs and o["f"] in stop_fs) or \
+                  (not stop_fs and (f.startswith("stop") or
+                                    f.startswith("heal") or
+                                    f.startswith("resume")))
+        if is_start:
+            starts.append(o)
+        elif is_stop and starts:
+            intervals.append((starts.pop(), o))
+    intervals.extend((s, None) for s in starts)
+    return intervals
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Compact string for a set of integers: '#{1 3-5 7}'
+    (reference util.clj:629)."""
+    xs = sorted(set(xs))
+    parts = []
+    i = 0
+    while i < len(xs):
+        j = i
+        while j + 1 < len(xs) and xs[j + 1] == xs[j] + 1:
+            j += 1
+        if j == i:
+            parts.append(str(xs[i]))
+        elif j == i + 1:
+            parts.append(str(xs[i]))
+            parts.append(str(xs[j]))
+        else:
+            parts.append(f"{xs[i]}-{xs[j]}")
+        i = j + 1
+    return "#{" + " ".join(parts) + "}"
+
+
+def longest_common_prefix(seqs: Sequence[Sequence]) -> list:
+    if not seqs:
+        return []
+    prefix = list(seqs[0])
+    for s in seqs[1:]:
+        n = 0
+        for a, b in zip(prefix, s):
+            if a != b:
+                break
+            n += 1
+        prefix = prefix[:n]
+        if not prefix:
+            break
+    return prefix
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n: majority(5) = 3."""
+    return n // 2 + 1
+
+
+def quantile(sorted_xs: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile of a pre-sorted sequence."""
+    if not sorted_xs:
+        return math.nan
+    i = min(len(sorted_xs) - 1, max(0, math.ceil(q * len(sorted_xs)) - 1))
+    return sorted_xs[i]
+
+
+def fraction(a: float, b: float) -> float:
+    """a/b, but 1 when b is 0 (reference checker stats convention)."""
+    return a / b if b else 1.0

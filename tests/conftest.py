@@ -1,0 +1,15 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so every sharding/pjit path is
+exercised hermetically (no TPU needed), matching how the driver dry-runs the
+multi-chip path. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,133 @@
+"""History/op model tests (reference test strategy: literal op vectors in,
+derived structure out — jepsen/test/jepsen/ style)."""
+
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu import txn
+
+
+def mk(type, f, value, process, time=0):
+    return h.op(type, f, value, process, time)
+
+
+def test_index():
+    hist = h.History([mk("invoke", "read", None, 0),
+                      mk("ok", "read", 1, 0)])
+    idx = hist.index()
+    assert [o["index"] for o in idx] == [0, 1]
+
+
+def test_pairing_basic():
+    hist = h.History([
+        mk("invoke", "write", 1, 0),
+        mk("invoke", "read", None, 1),
+        mk("ok", "write", 1, 0),
+        mk("ok", "read", 1, 1),
+    ])
+    p = hist.pair_index()
+    assert p == {0: 2, 2: 0, 1: 3, 3: 1}
+    assert hist.completion(0)["type"] == "ok"
+
+
+def test_pairing_pending_and_nemesis():
+    hist = h.History([
+        mk("invoke", "write", 1, 0),
+        mk("info", "start-partition", None, "nemesis"),
+        mk("info", "write", 1, 0),          # crashed
+        mk("invoke", "write", 2, 0),        # process reused after info? no —
+    ])
+    p = hist.pair_index()
+    assert p[0] == 2 and p[2] == 0
+    assert 1 not in p          # nemesis doesn't pair
+    assert 3 not in p          # pending invoke
+
+
+def test_without_failures():
+    hist = h.History([
+        mk("invoke", "cas", (1, 2), 0),
+        mk("fail", "cas", (1, 2), 0),
+        mk("invoke", "write", 3, 1),
+        mk("ok", "write", 3, 1),
+    ])
+    out = hist.without_failures()
+    assert len(out) == 2
+    assert all(o["f"] == "write" for o in out)
+
+
+def test_filters():
+    hist = h.History([
+        mk("invoke", "read", None, 0),
+        mk("ok", "read", 5, 0),
+        mk("invoke", "write", 1, 1),
+        mk("info", "write", 1, 1),
+        mk("info", "kill", None, "nemesis"),
+    ])
+    assert len(hist.oks()) == 1
+    assert len(hist.infos()) == 2
+    assert len(hist.client_ops()) == 4
+    assert len(hist.filter_f("write")) == 2
+
+
+def test_encode_ops_register():
+    hist = h.History([
+        mk("invoke", "write", 1, 0, 10),
+        mk("ok", "write", 1, 0, 20),
+        mk("invoke", "read", None, 1, 15),
+        mk("ok", "read", 1, 1, 25),
+        mk("invoke", "cas", (1, 2), 0, 30),
+        mk("fail", "cas", (1, 2), 0, 40),      # dropped: fail
+        mk("invoke", "write", 9, 2, 35),
+        mk("info", "write", 9, 2, 45),         # kept: pending write
+        mk("invoke", "read", None, 3, 36),     # dropped: pending read
+    ]).index()
+    ops = h.encode_ops(hist)
+    assert len(ops) == 3
+    # write op
+    assert ops.f[0] == h.F_WRITE and ops.a[0] == 1
+    assert ops.kind[0] == h.KIND_OK
+    assert ops.inv[0] == 0 and ops.ret[0] == 1
+    # read op: completion value is authoritative
+    assert ops.f[1] == h.F_READ and ops.a[1] == 1
+    # pending write
+    assert ops.kind[2] == h.KIND_INFO
+    assert ops.ret[2] == h.PENDING_RET
+    assert ops.process.dtype == np.int32
+
+
+def test_encode_ops_cas_values():
+    hist = h.History([
+        mk("invoke", "cas", (3, 4), 0),
+        mk("ok", "cas", (3, 4), 0),
+    ]).index()
+    ops = h.encode_ops(hist)
+    assert ops.f[0] == h.F_CAS and ops.a[0] == 3 and ops.b[0] == 4
+
+
+# -- txn ---------------------------------------------------------------------
+
+def test_ext_reads():
+    assert txn.ext_reads([["r", "x", 1], ["w", "y", 2], ["r", "y", 3]]) \
+        == {"x": 1}
+    assert txn.ext_reads([["r", "x", 1], ["r", "x", 2]]) == {"x": 1}
+    assert txn.ext_reads([["w", "x", 1], ["r", "x", 1]]) == {}
+
+
+def test_ext_writes():
+    assert txn.ext_writes([["w", "x", 1], ["w", "x", 2], ["r", "y", 3]]) \
+        == {"x": 2}
+    assert txn.ext_writes([["r", "x", 1]]) == {}
+
+
+def test_int_write_mops():
+    assert txn.int_write_mops([["w", "x", 1], ["w", "x", 2], ["w", "y", 3]]) \
+        == {"x": [["w", "x", 1]]}
+    assert txn.int_write_mops([["w", "x", 1]]) == {}
+
+
+def test_reduce_mops_and_op_mops():
+    hist = [{"value": [["r", "x", 1], ["w", "y", 2]]},
+            {"value": [["w", "x", 3]]}]
+    total = txn.reduce_mops(lambda acc, op, mop: acc + 1, 0, hist)
+    assert total == 3
+    assert len(list(txn.op_mops(hist))) == 3

@@ -1,0 +1,94 @@
+import time
+
+import pytest
+
+from jepsen_tpu import util
+
+
+def test_real_pmap():
+    assert util.real_pmap(lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+
+
+def test_real_pmap_propagates_crash():
+    def boom(x):
+        if x == 2:
+            raise ValueError("boom")
+        return x
+    with pytest.raises(ValueError):
+        util.real_pmap(boom, [1, 2, 3])
+
+
+def test_bounded_pmap_order():
+    assert util.bounded_pmap(lambda x: -x, range(10), max_workers=3) \
+        == [-x for x in range(10)]
+
+
+def test_relative_time():
+    with util.relative_time():
+        a = util.relative_time_nanos()
+        b = util.relative_time_nanos()
+        assert 0 <= a <= b
+    with pytest.raises(RuntimeError):
+        util.relative_time_nanos()
+
+
+def test_timeout():
+    assert util.timeout(5, lambda: 42) == 42
+    assert util.timeout(0.05, lambda: time.sleep(1), default="late") == "late"
+    with pytest.raises(util.Timeout):
+        util.timeout(0.05, lambda: time.sleep(1))
+
+
+def test_await_fn():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("not yet")
+        return "done"
+
+    assert util.await_fn(flaky, retry_interval=0.01, timeout_secs=5) == "done"
+    with pytest.raises(util.Timeout):
+        util.await_fn(lambda: 1 / 0, retry_interval=0.01, timeout_secs=0.05)
+
+
+def test_integer_interval_set_str():
+    assert util.integer_interval_set_str([1, 3, 4, 5, 7]) == "#{1 3-5 7}"
+    assert util.integer_interval_set_str([]) == "#{}"
+    assert util.integer_interval_set_str([1, 2]) == "#{1 2}"
+
+
+def test_nemesis_intervals():
+    hist = [
+        {"process": "nemesis", "type": "info", "f": "start-partition",
+         "value": None, "time": 1},
+        {"process": 0, "type": "invoke", "f": "read", "value": None,
+         "time": 2},
+        {"process": "nemesis", "type": "info", "f": "stop-partition",
+         "value": None, "time": 3},
+        {"process": "nemesis", "type": "info", "f": "start-kill",
+         "value": None, "time": 4},
+    ]
+    ivals = util.nemesis_intervals(hist)
+    assert len(ivals) == 2
+    assert ivals[0][0]["f"] == "start-partition"
+    assert ivals[0][1]["f"] == "stop-partition"
+    assert ivals[1] == (hist[3], None)
+
+
+def test_history_latencies():
+    hist = [
+        {"process": 0, "type": "invoke", "f": "read", "value": None,
+         "time": 100},
+        {"process": 0, "type": "ok", "f": "read", "value": 1, "time": 350},
+    ]
+    lats = util.history_latencies(hist)
+    assert len(lats) == 1 and lats[0]["latency"] == 250
+
+
+def test_majority_and_quantile():
+    assert util.majority(5) == 3
+    assert util.majority(4) == 3
+    assert util.quantile([1, 2, 3, 4], 0.5) == 2
+    assert util.quantile([1, 2, 3, 4], 1.0) == 4
