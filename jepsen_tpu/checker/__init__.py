@@ -1,0 +1,150 @@
+"""Checker core: protocol, validity lattice, composition.
+
+Behavioral parity with `jepsen/src/jepsen/checker.clj:29-116`: the validity
+lattice (true < :unknown < false), exception-absorbing `check_safe`, parallel
+`compose`, and `concurrency_limit` for memory-heavy checkers.
+
+A checker is any object with ``check(test, history, opts) -> result-dict``;
+results carry a ``'valid?'`` key which is True, False, or the string
+``'unknown'``. Plain functions ``f(test, history, opts)`` are adapted
+automatically.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Mapping
+
+from ..history import History, history
+from ..util import bounded_pmap
+
+UNKNOWN = "unknown"
+
+# :valid? priorities — larger dominates in composition
+# (reference checker.clj:29-34).
+_VALID_PRIORITIES = {True: 0, UNKNOWN: 0.5, False: 1}
+
+
+def merge_valid(valids) -> Any:
+    """Merge :valid? values; the highest-priority (worst) wins."""
+    out = True
+    for v in valids:
+        if v not in _VALID_PRIORITIES:
+            raise ValueError(f"{v!r} is not a known valid? value")
+        if _VALID_PRIORITIES[v] > _VALID_PRIORITIES[out]:
+            out = v
+    return out
+
+
+class Checker:
+    """Protocol base. Subclasses implement check()."""
+
+    def check(self, test: Mapping, hist: History, opts: Mapping) -> dict:
+        raise NotImplementedError
+
+    def __call__(self, test, hist, opts=None):
+        return self.check(test, hist, opts or {})
+
+
+class FnChecker(Checker):
+    """Adapts a plain function into a Checker."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def check(self, test, hist, opts):
+        return self.fn(test, hist, opts)
+
+
+def coerce(c) -> Checker:
+    if isinstance(c, Checker):
+        return c
+    if callable(c):
+        return FnChecker(c)
+    raise TypeError(f"not a checker: {c!r}")
+
+
+class _Noop(Checker):
+    def check(self, test, hist, opts):
+        return None
+
+
+def noop() -> Checker:
+    """A checker that returns nothing (reference checker.clj:68-72)."""
+    return _Noop()
+
+
+class _UnbridledOptimism(Checker):
+    def check(self, test, hist, opts):
+        return {"valid?": True}
+
+
+def unbridled_optimism() -> Checker:
+    """Everything is awesome (reference checker.clj:118-122)."""
+    return _UnbridledOptimism()
+
+
+def check_safe(checker, test, hist, opts=None) -> dict:
+    """check(), but exceptions come back as {'valid?': 'unknown', ...}
+    (reference checker.clj:74-85)."""
+    try:
+        return coerce(checker).check(test, history(hist), opts or {})
+    except Exception:  # noqa: BLE001 — checker crashes must not kill the run
+        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Runs a map of named checkers (in parallel) and merges validity
+    (reference checker.clj:87-99)."""
+
+    def __init__(self, checker_map: Mapping[str, Any]):
+        self.checkers = {k: coerce(c) for k, c in checker_map.items()}
+
+    def check(self, test, hist, opts):
+        hist = history(hist)
+        items = list(self.checkers.items())
+        results = bounded_pmap(
+            lambda kv: (kv[0], check_safe(kv[1], test, hist, opts)),
+            items, max_workers=8)
+        out: dict = dict(results)
+        out["valid?"] = merge_valid(
+            r.get("valid?", True) for _, r in results if r is not None)
+        return out
+
+
+def compose(checker_map: Mapping[str, Any]) -> Checker:
+    return Compose(checker_map)
+
+
+class ConcurrencyLimit(Checker):
+    """Bounds concurrent executions of a checker with a fair semaphore
+    (reference checker.clj:101-116)."""
+
+    def __init__(self, limit: int, checker):
+        self.sem = threading.Semaphore(limit)
+        self.checker = coerce(checker)
+
+    def check(self, test, hist, opts):
+        with self.sem:
+            return self.checker.check(test, hist, opts)
+
+
+def concurrency_limit(limit: int, checker) -> Checker:
+    return ConcurrencyLimit(limit, checker)
+
+
+# Re-exports of the standard checkers (defined in submodules).
+from .basic import (  # noqa: E402
+    counter, log_file_pattern, queue, set_checker, set_full, stats,
+    total_queue, unhandled_exceptions, unique_ids,
+)
+from .linear import linearizable  # noqa: E402
+
+__all__ = [
+    "Checker", "UNKNOWN", "merge_valid", "check_safe", "compose",
+    "concurrency_limit", "noop", "unbridled_optimism", "coerce",
+    "stats", "unhandled_exceptions", "set_checker", "set_full", "queue",
+    "total_queue", "unique_ids", "counter", "log_file_pattern",
+    "linearizable",
+]

@@ -1,0 +1,191 @@
+"""Linearizability checking — host reference implementation.
+
+This is the CPU oracle for the TPU kernels (checker/wgl.py): a just-in-time
+linearization search in the style of knossos.linear (the reference consumes
+knossos via `jepsen/src/jepsen/checker.clj:185-216`). The algorithm walks the
+history entry by entry, maintaining the set of *configurations* — pairs of
+(model state, subset of currently-pending operations already linearized).
+
+  * at an invocation, the op joins the pending set (not yet linearized);
+  * at an :ok completion of op i, configurations expand by linearizing any
+    sequence of pending ops; configurations in which i has not linearized by
+    its completion are killed (its linearization point must lie between
+    invocation and completion);
+  * :fail pairs never took effect and are excluded up front;
+  * :info ops stay pending forever — they may linearize at any later point,
+    or never (crashed reads constrain nothing and are dropped);
+  * the history is linearizable iff a configuration survives every entry.
+
+Works with arbitrary hashable models (models.Model). The TPU path handles
+the enumerable-state models at scale; `linearizable()` dispatches.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from .. import models as m
+from ..history import History, history as as_history, is_fail, is_info, \
+    is_invoke, is_ok
+from . import Checker, UNKNOWN
+
+
+def _prepare(hist: History):
+    """Lower an indexed client history to a list of entries:
+    ('invoke', op_id, op) / ('ok', op_id, op). op_id is the invocation's
+    history index; the op dict carries the authoritative value (completion
+    value for :ok ops). Fail pairs and pending reads are dropped."""
+    hist = as_history(hist).client_ops()
+    pairs = hist.pair_index()
+    entries = []
+    for i, o in enumerate(hist.ops):
+        if not is_invoke(o):
+            continue
+        j = pairs.get(i)
+        comp = hist.ops[j] if j is not None else None
+        if comp is not None and is_fail(comp):
+            continue
+        if comp is None or is_info(comp):
+            if o["f"] in ("read", "r"):
+                continue  # a pending read constrains nothing
+            entries.append((i, None, dict(o)))
+        else:
+            op = dict(o)
+            op["type"] = comp["type"]
+            op["value"] = comp["value"]
+            entries.append((i, j, op))
+    # Emit in history order: invoke events at position i, ok events at j.
+    events = []
+    for i, j, op in entries:
+        events.append((i, "invoke", i, op))
+        if j is not None:
+            events.append((j, "ok", i, op))
+    events.sort(key=lambda e: e[0])
+    return [(kind, op_id, op) for _, kind, op_id, op in events]
+
+
+def _closure(configs: set, pending: dict) -> set:
+    """All configurations reachable by linearizing pending ops in any
+    order. A configuration is (model, frozenset-of-linearized-op-ids)."""
+    stack = list(configs)
+    seen = set(configs)
+    while stack:
+        model, lin = stack.pop()
+        for op_id, op in pending.items():
+            if op_id in lin:
+                continue
+            m2 = model.step(op)
+            if m.is_inconsistent(m2):
+                continue
+            c = (m2, lin | {op_id})
+            if c not in seen:
+                seen.add(c)
+                stack.append(c)
+    return seen
+
+
+def analysis_host(model: m.Model, hist) -> dict:
+    """Run the JIT-linearization search on the host. Returns an analysis map
+    with 'valid?' plus failure diagnostics."""
+    t0 = _time.monotonic()
+    events = _prepare(as_history(hist).index())
+    empty: frozenset = frozenset()
+    configs: set = {(model, empty)}
+    pending: dict[int, dict] = {}
+    op_count = sum(1 for e in events if e[0] == "invoke")
+    previous_ok = None
+    for kind, op_id, op in events:
+        if kind == "invoke":
+            pending[op_id] = op
+            continue
+        # :ok completion — op_id must linearize by now.
+        expanded = _closure(configs, pending)
+        survivors = {(mod, lin) for (mod, lin) in expanded if op_id in lin}
+        if not survivors:
+            return {
+                "valid?": False,
+                "op": op,
+                "previous-ok": previous_ok,
+                "op-count": op_count,
+                "analyzer": "host-jit-linear",
+                "configs": [_config_info(c, pending)
+                            for c in sorted(expanded,
+                                            key=lambda c: -len(c[1]))[:10]],
+                "final-paths": [],
+                "duration-ms": (_time.monotonic() - t0) * 1e3,
+            }
+        del pending[op_id]
+        configs = {(mod, lin - {op_id}) for (mod, lin) in survivors}
+        previous_ok = op
+    return {"valid?": True,
+            "op-count": op_count,
+            "analyzer": "host-jit-linear",
+            "configs": [_config_info(c, pending)
+                        for c in list(configs)[:10]],
+            "final-paths": [],
+            "duration-ms": (_time.monotonic() - t0) * 1e3}
+
+
+def _config_info(config, pending) -> dict:
+    model, lin = config
+    return {"model": repr(model),
+            "pending": [pending[i] for i in sorted(lin) if i in pending],
+            "linearized-pending": sorted(lin)}
+
+
+class Linearizable(Checker):
+    """Linearizability checker (reference checker.clj:185-216). Algorithms:
+
+      'host'  — pure-Python JIT-linearization (any model)
+      'tpu'   — JAX frontier-BFS kernel (enumerable-state models)
+      'auto'  — tpu when the model has a device form, else host
+      'linear'/'wgl'/'competition' — accepted aliases (reference names);
+                 mapped to 'auto'.
+    """
+
+    def __init__(self, model: m.Model, algorithm: str = "auto", **opts):
+        assert model is not None, \
+            "the linearizable checker requires a model"
+        self.model = model
+        self.algorithm = algorithm
+        self.opts = opts
+
+    def check(self, test, hist, opts):
+        algo = self.algorithm
+        if algo in ("linear", "wgl", "competition"):
+            algo = "auto"
+        if algo in ("auto", "tpu"):
+            if self.model.device_model is not None:
+                try:
+                    from .wgl import analysis_tpu
+                    a = analysis_tpu(self.model, hist, **self.opts)
+                    return _truncate(a)
+                except ImportError:
+                    if algo == "tpu":
+                        raise
+            elif algo == "tpu":
+                return {"valid?": UNKNOWN,
+                        "error": f"model {self.model!r} has no device form"}
+        return _truncate(analysis_host(self.model, hist))
+
+
+def _truncate(a: dict) -> dict:
+    """Writing full configs/final-paths 'can take hours' — truncate to 10
+    (reference checker.clj:213-216)."""
+    a["final-paths"] = list(a.get("final-paths", []))[:10]
+    a["configs"] = list(a.get("configs", []))[:10]
+    return a
+
+
+def linearizable(model_or_opts, algorithm: str = "auto", **opts) -> Checker:
+    """Build a linearizability checker. Accepts linearizable(model) or the
+    reference's map shape linearizable({'model': m, 'algorithm': 'wgl'})."""
+    if isinstance(model_or_opts, dict):
+        o = dict(model_or_opts)
+        model = o.pop("model")
+        algorithm = o.pop("algorithm", algorithm)
+        opts = {**o, **opts}
+    else:
+        model = model_or_opts
+    return Linearizable(model, algorithm, **opts)

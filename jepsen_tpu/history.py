@@ -239,10 +239,14 @@ class OpArray:
       a        int32 — 1st argument (write value, cas old, read-observed)
       b        int32 — 2nd argument (cas new), NIL otherwise
       kind     int32 — KIND_OK | KIND_INFO
-      inv      int64 — invocation position in the indexed history
-      ret      int64 — completion position, or 2**62 for pending/info
+      inv      int32 — invocation's rank within the client-op stream this
+                       array was built from (ordering only)
+      ret      int32 — completion's rank in that same stream, or
+                       PENDING_RET (int32 max) for pending/:info ops
       process  int32 — process id (client ops only)
-      index    int32 — invocation's op index in the source history
+      index    int32 — invocation's :index in the source history (equals
+                       inv-rank only if the history was pre-filtered);
+                       use this to point back at real ops
 
     Failed ops are excluded (they did not take effect); crashed reads are
     excluded (a pending read constrains nothing). See checker/wgl.py for the
@@ -265,7 +269,9 @@ class OpArray:
         return int((self.kind == KIND_OK).sum())
 
 
-PENDING_RET = np.int64(2) ** 62
+# int32 max: ships to TPU unharmed (x64 is typically disabled, and TPUs
+# have no native int64 — an int64 sentinel like 2**62 would silently wrap).
+PENDING_RET = np.int32(2**31 - 1)
 
 
 def default_register_codec(o: dict) -> tuple[int, int, int]:
@@ -298,6 +304,8 @@ def encode_ops(h: History,
     *completion's* value is authoritative for :ok ops (a read's observed
     value arrives on the :ok op).
     """
+    if h.ops and "index" not in h.ops[0]:
+        h = h.index()
     h = h.client_ops()
     pairs = h.pair_index()
     rows = []
@@ -328,8 +336,8 @@ def encode_ops(h: History,
         a=np.asarray(cols[1], np.int32),
         b=np.asarray(cols[2], np.int32),
         kind=np.asarray(cols[3], np.int32),
-        inv=np.asarray(cols[4], np.int64),
-        ret=np.asarray(cols[5], np.int64),
+        inv=np.asarray(cols[4], np.int32),
+        ret=np.asarray(cols[5], np.int32),
         process=np.asarray(cols[6], np.int32),
         index=np.asarray(cols[7], np.int32),
     )

@@ -1,0 +1,242 @@
+"""System models for linearizability checking.
+
+Equivalents of knossos.model (the reference consumes these via
+`jepsen/src/jepsen/checker.clj:185-216` and per-suite model definitions,
+e.g. `jepsen/src/jepsen/tests/linearizable_register.clj:37`).
+
+A model is an immutable, hashable value with a ``step(op) -> model`` method;
+stepping with an impossible op returns an ``Inconsistent`` describing why.
+Device kernels use the *enumerable* subset (register family, mutex) via
+integer state encodings declared here; arbitrary Python models fall back to
+the host checker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from .history import F_CAS, F_READ, F_WRITE, NIL
+
+
+class Inconsistent:
+    """A terminal model state: the op could not have happened."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Inconsistent) and self.msg == other.msg
+
+    def __hash__(self):
+        return hash(("inconsistent", self.msg))
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(m: Any) -> bool:
+    return isinstance(m, Inconsistent)
+
+
+class Model:
+    """Base class. Subclasses must be immutable and hashable."""
+
+    def step(self, op: dict) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+    # -- device lowering ----------------------------------------------------
+    # Models that can run on TPU provide an integer state encoding plus the
+    # name of a registered device step function (see checker/wgl.py).
+    device_model: Optional[str] = None
+
+    def device_state(self) -> int:
+        raise NotImplementedError(f"{type(self).__name__} has no device form")
+
+
+@dataclasses.dataclass(frozen=True)
+class CASRegister(Model):
+    """A register supporting read/write/cas (knossos cas-register)."""
+    value: Any = None
+
+    device_model = "cas-register"
+
+    def step(self, op: dict):
+        f, v = op["f"], op["value"]
+        if f in ("write", "w"):
+            return CASRegister(v)
+        if f == "cas":
+            old, new = v
+            if self.value != old:
+                return inconsistent(
+                    f"can't CAS {self.value!r} from {old!r} to {new!r}")
+            return CASRegister(new)
+        if f in ("read", "r"):
+            if v is None or self.value == v:
+                return self
+            return inconsistent(f"can't read {v!r} from {self.value!r}")
+        return inconsistent(f"unknown op f={f!r}")
+
+    def device_state(self) -> int:
+        return NIL if self.value is None else int(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Register(Model):
+    """A read/write register (knossos register)."""
+    value: Any = None
+
+    device_model = "register"
+
+    def step(self, op: dict):
+        f, v = op["f"], op["value"]
+        if f in ("write", "w"):
+            return Register(v)
+        if f in ("read", "r"):
+            if v is None or self.value == v:
+                return self
+            return inconsistent(f"can't read {v!r} from {self.value!r}")
+        return inconsistent(f"unknown op f={f!r}")
+
+    def device_state(self) -> int:
+        return NIL if self.value is None else int(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutex(Model):
+    """A lock with acquire/release (knossos mutex)."""
+    locked: bool = False
+
+    device_model = "mutex"
+
+    def step(self, op: dict):
+        f = op["f"]
+        if f == "acquire":
+            if self.locked:
+                return inconsistent("cannot acquire a held lock")
+            return Mutex(True)
+        if f == "release":
+            if not self.locked:
+                return inconsistent("cannot release a free lock")
+            return Mutex(False)
+        return inconsistent(f"unknown op f={f!r}")
+
+    def device_state(self) -> int:
+        return 1 if self.locked else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class NoOp(Model):
+    """A model which considers any op legal (knossos noop)."""
+
+    def step(self, op: dict):
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """A queue where dequeues may return any enqueued-but-not-yet-dequeued
+    element (knossos unordered-queue). State is a frozen multiset."""
+    pending: frozenset = frozenset()  # of (value, dup-count) expanded pairs
+
+    @staticmethod
+    def _add(pending: frozenset, v: Any) -> frozenset:
+        n = sum(1 for (x, _) in pending if x == v)
+        return pending | {(v, n)}
+
+    @staticmethod
+    def _remove(pending: frozenset, v: Any):
+        matches = [(x, i) for (x, i) in pending if x == v]
+        if not matches:
+            return None
+        return pending - {max(matches, key=lambda t: t[1])}
+
+    def step(self, op: dict):
+        f, v = op["f"], op["value"]
+        if f == "enqueue":
+            return UnorderedQueue(self._add(self.pending, v))
+        if f == "dequeue":
+            rest = self._remove(self.pending, v)
+            if rest is None:
+                return inconsistent(f"can't dequeue {v!r}: not in queue")
+            return UnorderedQueue(rest)
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FIFOQueue(Model):
+    """A strictly-ordered queue (knossos fifo-queue)."""
+    items: tuple = ()
+
+    def step(self, op: dict):
+        f, v = op["f"], op["value"]
+        if f == "enqueue":
+            return FIFOQueue(self.items + (v,))
+        if f == "dequeue":
+            if not self.items:
+                return inconsistent(f"can't dequeue {v!r} from empty queue")
+            if self.items[0] != v:
+                return inconsistent(
+                    f"can't dequeue {v!r}: head is {self.items[0]!r}")
+            return FIFOQueue(self.items[1:])
+        return inconsistent(f"unknown op f={f!r}")
+
+
+def cas_register(value: Any = None) -> CASRegister:
+    return CASRegister(value)
+
+
+def register(value: Any = None) -> Register:
+    return Register(value)
+
+
+def mutex() -> Mutex:
+    return Mutex()
+
+
+def noop() -> NoOp:
+    return NoOp()
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue()
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue()
+
+
+# ---------------------------------------------------------------------------
+# Device step semantics (shared by host oracle and TPU kernel golden tests)
+# ---------------------------------------------------------------------------
+
+def device_step_register(state: int, f: int, a: int, b: int,
+                         cas: bool) -> tuple[bool, int]:
+    """Pure integer semantics of the register family; the JAX kernel in
+    checker/wgl.py implements exactly this with jnp ops.
+
+    Returns (legal, new_state). NIL means 'never written'.
+    """
+    if f == F_READ:
+        return (a == NIL or state == a), state
+    if f == F_WRITE:
+        return True, a
+    if f == F_CAS and cas:
+        return state == a, (b if state == a else state)
+    return False, state
+
+
+def device_step_mutex(state: int, f: int, a: int, b: int) \
+        -> tuple[bool, int]:
+    """f: 0 = acquire, 1 = release."""
+    if f == 0:
+        return state == 0, 1
+    if f == 1:
+        return state == 1, 0
+    return False, state

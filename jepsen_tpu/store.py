@@ -1,0 +1,166 @@
+"""Test persistence: run directories, history/results serialization.
+
+Reference: `jepsen/src/jepsen/store.clj` — runs live under
+``store/<test-name>/<date>/`` with ``latest``/``current`` symlinks, a
+two-phase save (history before analysis, results after), and re-loadable
+histories for post-hoc analysis. This module starts minimal (paths +
+save/load) and grows renderer/browser support in the reporting layer.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import gzip
+import json
+import os
+from typing import Any, Iterable
+
+from .history import History, history
+
+DEFAULT_BASE = "store"
+
+
+def base_dir(test) -> str:
+    return test.get("store-dir") or DEFAULT_BASE
+
+
+def dir_name(test) -> str:
+    """The directory for this test run: <base>/<name>/<start-time>."""
+    name = test.get("name", "noname")
+    start = test.get("start-time") or "unknown"
+    return os.path.join(base_dir(test), str(name), str(start))
+
+
+def path(test, *components) -> str:
+    """A path inside the test's store directory."""
+    return os.path.join(dir_name(test), *[str(c) for c in components])
+
+
+def make_path(test, *components) -> str:
+    """path(), creating parent directories."""
+    p = path(test, *components)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    return p
+
+
+def start_time() -> str:
+    return _dt.datetime.now().strftime("%Y%m%dT%H%M%S.%f%z")
+
+
+def update_symlinks(test) -> None:
+    """Point <base>/<name>/latest and <base>/latest at this run
+    (reference store.clj:316-343)."""
+    d = dir_name(test)
+    if not os.path.isdir(d):
+        return
+    for link in (os.path.join(base_dir(test), str(test.get("name", "noname")),
+                              "latest"),
+                 os.path.join(base_dir(test), "latest")):
+        try:
+            if os.path.islink(link):
+                os.unlink(link)
+            os.symlink(os.path.abspath(d), link)
+        except OSError:
+            pass
+
+
+# -- serialization ----------------------------------------------------------
+
+def _json_default(o: Any):
+    import numpy as np
+    if isinstance(o, (set, frozenset, tuple)):
+        return list(o)
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, BaseException):
+        return {"class": type(o).__name__, "message": str(o)}
+    return repr(o)
+
+
+def write_history(test, hist: Iterable[dict]) -> str:
+    """Write history.jsonl.gz — one op per line (replaces the reference's
+    Fressian binary history, store.clj:360)."""
+    p = make_path(test, "history.jsonl.gz")
+    with gzip.open(p, "wt") as fh:
+        for op in hist:
+            fh.write(json.dumps(op, default=_json_default) + "\n")
+    return p
+
+
+def load_history(test) -> History:
+    p = path(test, "history.jsonl.gz")
+    with gzip.open(p, "rt") as fh:
+        return history(json.loads(line) for line in fh if line.strip())
+
+
+def write_results(test, results: dict) -> str:
+    p = make_path(test, "results.json")
+    with open(p, "w") as fh:
+        json.dump(results, fh, indent=2, default=_json_default)
+    return p
+
+
+def load_results(test) -> dict:
+    with open(path(test, "results.json")) as fh:
+        return json.load(fh)
+
+
+def save_1(test) -> dict:
+    """Phase 1: persist the test map + history before analysis, so crashed
+    analyses still leave the history on disk (reference save-1!,
+    store.clj:388)."""
+    write_history(test, test.get("history", []))
+    meta = {k: v for k, v in test.items()
+            if k not in ("history", "results") and _plain(v)}
+    p = make_path(test, "test.json")
+    with open(p, "w") as fh:
+        json.dump(meta, fh, indent=2, default=_json_default)
+    update_symlinks(test)
+    return test
+
+
+def save_2(test) -> dict:
+    """Phase 2: persist analysis results (reference save-2!, store.clj:401)."""
+    write_results(test, test.get("results", {}))
+    update_symlinks(test)
+    return test
+
+
+def _plain(v) -> bool:
+    return isinstance(v, (str, int, float, bool, list, tuple, dict,
+                          type(None)))
+
+
+def tests(base: str = DEFAULT_BASE) -> dict:
+    """Map of test name -> {start-time -> run dir} for all stored runs
+    (reference store.clj:284)."""
+    out: dict = {}
+    if not os.path.isdir(base):
+        return out
+    for name in sorted(os.listdir(base)):
+        d = os.path.join(base, name)
+        if not os.path.isdir(d) or name == "latest":
+            continue
+        runs = {t: os.path.join(d, t) for t in sorted(os.listdir(d))
+                if not t.startswith("latest")
+                and os.path.isdir(os.path.join(d, t))}
+        if runs:
+            out[name] = runs
+    return out
+
+
+def latest(base: str = DEFAULT_BASE) -> str | None:
+    link = os.path.join(base, "latest")
+    return os.path.realpath(link) if os.path.islink(link) else None
+
+
+def delete(base: str = DEFAULT_BASE, name: str | None = None) -> None:
+    """Delete stored runs (reference store.clj:470)."""
+    import shutil
+    target = os.path.join(base, name) if name else base
+    if os.path.isdir(target):
+        shutil.rmtree(target)

@@ -35,7 +35,7 @@ def is_write(mop) -> bool:
 
 
 def is_mop(mop) -> bool:
-    return len(mop) == 3 and mop[0] in ("r", "w")
+    return len(mop) == 3 and mop[0] in ("r", "w", "append")
 
 
 # -- transaction reductions (reference: txn.clj) ----------------------------

@@ -20,20 +20,21 @@ from typing import Any, Callable, Iterable, Sequence
 # Relative time
 # ---------------------------------------------------------------------------
 
-_relative_origin = threading.local()
 _GLOBAL_ORIGIN: list[int | None] = [None]
 
 
 class relative_time:
     """Context manager establishing t=0 for a test run; all op :time fields
-    are nanoseconds since this origin (reference util.clj:333-347)."""
+    are nanoseconds since this origin (reference util.clj:333-347). Nesting
+    restores the enclosing origin on exit, like dynamic binding."""
 
     def __enter__(self):
+        self._prev = _GLOBAL_ORIGIN[0]
         _GLOBAL_ORIGIN[0] = _time.monotonic_ns()
         return self
 
     def __exit__(self, *exc):
-        _GLOBAL_ORIGIN[0] = None
+        _GLOBAL_ORIGIN[0] = self._prev
         return False
 
 
@@ -171,56 +172,67 @@ class NamedLocks:
 # ---------------------------------------------------------------------------
 
 def history_latencies(hist) -> list[dict]:
-    """Completions annotated with :latency (ns from invoke to completion);
-    pending invocations get no entry (reference util.clj:700)."""
-    from .history import is_invoke, is_client_op
-    open_by_process: dict = {}
-    out = []
+    """The same history, but every invocation gains :latency (ns to
+    completion) and :completion (the completing op); completions gain
+    :latency too. Pending invocations pass through unannotated
+    (reference util.clj history->latencies, :700)."""
+    from .history import is_invoke
+    out: list[dict] = []
+    open_idx: dict = {}  # process -> index into out
     for o in hist:
-        if not is_client_op(o):
-            continue
         if is_invoke(o):
-            open_by_process[o["process"]] = o
+            out.append(o)
+            open_idx[o["process"]] = len(out) - 1
         else:
-            inv = open_by_process.pop(o["process"], None)
-            if inv is not None and o.get("time") is not None \
-                    and inv.get("time") is not None:
-                oo = dict(o)
-                oo["latency"] = o["time"] - inv["time"]
-                out.append(oo)
+            i = open_idx.pop(o["process"], None)
+            if i is not None:
+                inv = out[i]
+                latency = o["time"] - inv["time"]
+                o = dict(o)
+                o["latency"] = latency
+                out[i] = {**inv, "latency": latency, "completion": o}
+            out.append(o)
     return out
 
 
 def nemesis_intervals(hist, start_fs: set | None = None,
                       stop_fs: set | None = None) -> list[tuple]:
-    """Pairs of (start-op, stop-op-or-None) intervals of nemesis activity
-    (reference util.clj:736). By default every nemesis op alternates
-    start/stop per :f pairing {start-x -> stop-x}; unmatched starts run to
-    the end of history (None)."""
+    """Pairs of (start-op, stop-op-or-None) nemesis activity intervals
+    (reference util.clj nemesis-intervals, :736-782). Nemesis ops arrive in
+    invoke/complete pairs; a stop pair closes *all* open start pairs:
+    start1 start2 stop1 yields [s1a stop1a] [s1b stop1b] [s2a stop1a]
+    [s2b stop1b]. Unclosed starts pair with None."""
     from .history import NEMESIS
-    starts: list = []
+    start_fs = start_fs or {"start"}
+    stop_fs = stop_fs or {"stop"}
+    nem = [o for o in hist if o.get("process") == NEMESIS]
+    # Group into invoke/complete pairs with matching :f.
+    pairs = [(a, b) for a, b in zip(nem[::2], nem[1::2])
+             if a.get("f") == b.get("f")]
     intervals: list[tuple] = []
-    for o in hist:
-        if o.get("process") != NEMESIS or o["type"] != "info":
-            continue
-        f = str(o.get("f", ""))
-        is_start = (start_fs and o["f"] in start_fs) or \
-                   (not start_fs and f.startswith("start"))
-        is_stop = (stop_fs and o["f"] in stop_fs) or \
-                  (not stop_fs and (f.startswith("stop") or
-                                    f.startswith("heal") or
-                                    f.startswith("resume")))
-        if is_start:
-            starts.append(o)
-        elif is_stop and starts:
-            intervals.append((starts.pop(), o))
-    intervals.extend((s, None) for s in starts)
+    starts: list[tuple] = []
+    for a, b in pairs:
+        if a["f"] in start_fs:
+            starts.append((a, b))
+        elif a["f"] in stop_fs:
+            for s1, s2 in starts:
+                intervals.append((s1, a))
+                intervals.append((s2, b))
+            starts = []
+    for s1, s2 in starts:
+        intervals.append((s1, None))
+        intervals.append((s2, None))
     return intervals
 
 
 def integer_interval_set_str(xs: Iterable[int]) -> str:
-    """Compact string for a set of integers: '#{1 3-5 7}'
-    (reference util.clj:629)."""
+    """Compact string for a set of integers: '#{1 3..5 7}'
+    (reference util.clj integer-interval-set-str, :629-654). Any run of
+    length >= 2 renders as 'start..end'; None elements fall back to a
+    plain set rendering."""
+    xs = list(xs)
+    if any(x is None for x in xs):
+        return "#{" + " ".join(str(x) for x in xs) + "}"
     xs = sorted(set(xs))
     parts = []
     i = 0
@@ -228,13 +240,7 @@ def integer_interval_set_str(xs: Iterable[int]) -> str:
         j = i
         while j + 1 < len(xs) and xs[j + 1] == xs[j] + 1:
             j += 1
-        if j == i:
-            parts.append(str(xs[i]))
-        elif j == i + 1:
-            parts.append(str(xs[i]))
-            parts.append(str(xs[j]))
-        else:
-            parts.append(f"{xs[i]}-{xs[j]}")
+        parts.append(str(xs[i]) if j == i else f"{xs[i]}..{xs[j]}")
         i = j + 1
     return "#{" + " ".join(parts) + "}"
 

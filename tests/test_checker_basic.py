@@ -1,0 +1,308 @@
+"""Checker tests on literal histories (mirrors the reference's
+jepsen/test/jepsen/checker_test.clj strategy)."""
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History
+
+
+def op(type, f, value, process=0, time=0, **kw):
+    return {"type": type, "f": f, "value": value, "process": process,
+            "time": time, **kw}
+
+
+# -- core --------------------------------------------------------------------
+
+def test_merge_valid():
+    assert c.merge_valid([]) is True
+    assert c.merge_valid([True, True]) is True
+    assert c.merge_valid([True, c.UNKNOWN]) == c.UNKNOWN
+    assert c.merge_valid([c.UNKNOWN, False]) is False
+    with pytest.raises(ValueError):
+        c.merge_valid(["nope"])
+
+
+def test_check_safe_catches():
+    def boom(test, hist, opts):
+        raise RuntimeError("kaboom")
+    r = c.check_safe(boom, {}, History([]), {})
+    assert r["valid?"] == c.UNKNOWN and "kaboom" in r["error"]
+
+
+def test_compose():
+    good = lambda t, h, o: {"valid?": True}          # noqa: E731
+    bad = lambda t, h, o: {"valid?": False}          # noqa: E731
+    r = c.compose({"a": good, "b": bad}).check({}, History([]), {})
+    assert r["valid?"] is False
+    assert r["a"]["valid?"] is True and r["b"]["valid?"] is False
+
+
+def test_concurrency_limit():
+    inner = lambda t, h, o: {"valid?": True}         # noqa: E731
+    r = c.concurrency_limit(2, inner).check({}, History([]), {})
+    assert r["valid?"] is True
+
+
+def test_noop_and_optimism():
+    assert c.noop().check({}, History([]), {}) is None
+    assert c.unbridled_optimism().check({}, History([]), {})["valid?"]
+
+
+# -- stats -------------------------------------------------------------------
+
+def test_stats():
+    hist = History([
+        op("invoke", "read", None), op("ok", "read", 1),
+        op("invoke", "write", 2), op("fail", "write", 2),
+        op("invoke", "write", 3), op("ok", "write", 3),
+    ])
+    r = c.stats().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["ok-count"] == 2 and r["fail-count"] == 1
+    assert r["by-f"]["read"]["ok-count"] == 1
+
+
+def test_stats_invalid_when_f_never_ok():
+    hist = History([op("invoke", "write", 2), op("fail", "write", 2)])
+    r = c.stats().check({}, hist, {})
+    assert r["valid?"] is False
+
+
+# -- unhandled exceptions ------------------------------------------------------
+
+def test_unhandled_exceptions():
+    hist = History([
+        op("info", "read", None, exception={"class": "TimeoutError",
+                                            "message": "hi"}),
+        op("info", "read", None, exception={"class": "TimeoutError",
+                                            "message": "again"}),
+        op("info", "write", 2, exception={"class": "IOError",
+                                          "message": "x"}),
+    ])
+    r = c.unhandled_exceptions().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["exceptions"][0]["class"] == "TimeoutError"
+    assert r["exceptions"][0]["count"] == 2
+
+
+# -- set ----------------------------------------------------------------------
+
+def test_set_checker_ok():
+    hist = History([
+        op("invoke", "add", 0), op("ok", "add", 0),
+        op("invoke", "add", 1), op("info", "add", 1),
+        op("invoke", "read", None), op("ok", "read", [0, 1]),
+    ])
+    r = c.set_checker().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["recovered-count"] == 1  # element 1's add crashed but appeared
+
+
+def test_set_checker_lost_and_unexpected():
+    hist = History([
+        op("invoke", "add", 0), op("ok", "add", 0),
+        op("invoke", "read", None), op("ok", "read", [9]),
+    ])
+    r = c.set_checker().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["lost-count"] == 1 and r["unexpected-count"] == 1
+
+
+def test_set_checker_never_read():
+    r = c.set_checker().check({}, History([op("invoke", "add", 0)]), {})
+    assert r["valid?"] == c.UNKNOWN
+
+
+# -- set-full -------------------------------------------------------------------
+
+def test_set_full_stable():
+    hist = History([
+        op("invoke", "add", 0, process=0, time=0),
+        op("ok", "add", 0, process=0, time=10),
+        op("invoke", "read", None, process=1, time=20),
+        op("ok", "read", [0], process=1, time=30),
+    ])
+    r = c.set_full().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["stable-count"] == 1 and r["lost-count"] == 0
+
+
+def test_set_full_lost():
+    hist = History([
+        op("invoke", "add", 0, process=0, time=0),
+        op("ok", "add", 0, process=0, time=10),
+        op("invoke", "read", None, process=1, time=20),
+        op("ok", "read", [0], process=1, time=30),
+        op("invoke", "read", None, process=1, time=40),
+        op("ok", "read", [], process=1, time=50),
+    ])
+    r = c.set_full().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["lost"] == [0]
+
+
+def test_set_full_never_read():
+    hist = History([
+        op("invoke", "add", 0, process=0, time=0),
+        op("ok", "add", 0, process=0, time=10),
+    ])
+    r = c.set_full().check({}, hist, {})
+    assert r["valid?"] == c.UNKNOWN
+    assert r["never-read"] == [0]
+
+
+def test_set_full_absent_read_concurrent_with_add_is_not_lost():
+    # the read missing element 0 is concurrent with its add: never-read,
+    # not lost (reference checker.clj:363-381 asymmetry)
+    hist = History([
+        op("invoke", "read", None, process=1, time=0),
+        op("invoke", "add", 0, process=0, time=1),
+        op("ok", "add", 0, process=0, time=10),
+        op("ok", "read", [], process=1, time=11),
+    ])
+    r = c.set_full().check({}, hist, {})
+    assert r["lost-count"] == 0
+
+
+def test_set_full_duplicates():
+    hist = History([
+        op("invoke", "add", 0, process=0, time=0),
+        op("ok", "add", 0, process=0, time=1),
+        op("invoke", "read", None, process=1, time=2),
+        op("ok", "read", [0, 0], process=1, time=3),
+    ])
+    r = c.set_full().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["duplicated"] == {0: 2}
+
+
+# -- queues ---------------------------------------------------------------------
+
+def test_queue_checker():
+    hist = History([
+        op("invoke", "enqueue", 1, process=0),
+        op("ok", "enqueue", 1, process=0),
+        op("invoke", "dequeue", None, process=1),
+        op("ok", "dequeue", 1, process=1),
+    ])
+    r = c.queue(m.unordered_queue()).check({}, hist, {})
+    assert r["valid?"] is True
+
+
+def test_queue_checker_phantom_dequeue():
+    hist = History([
+        op("invoke", "dequeue", None, process=1),
+        op("ok", "dequeue", 9, process=1),
+    ])
+    r = c.queue(m.unordered_queue()).check({}, hist, {})
+    assert r["valid?"] is False
+
+
+def test_total_queue():
+    hist = History([
+        op("invoke", "enqueue", 1, process=0),
+        op("ok", "enqueue", 1, process=0),
+        op("invoke", "enqueue", 2, process=0),
+        op("info", "enqueue", 2, process=0),
+        op("invoke", "drain", None, process=1),
+        op("ok", "drain", [1, 2], process=1),
+    ])
+    r = c.total_queue().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["recovered-count"] == 1
+
+
+def test_total_queue_lost_and_unexpected():
+    hist = History([
+        op("invoke", "enqueue", 1, process=0),
+        op("ok", "enqueue", 1, process=0),
+        op("invoke", "dequeue", None, process=1),
+        op("ok", "dequeue", 99, process=1),
+    ])
+    r = c.total_queue().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["lost-count"] == 1 and r["unexpected-count"] == 1
+
+
+# -- unique ids -------------------------------------------------------------------
+
+def test_unique_ids():
+    hist = History([
+        op("invoke", "generate", None), op("ok", "generate", 1),
+        op("invoke", "generate", None), op("ok", "generate", 2),
+    ])
+    r = c.unique_ids().check({}, hist, {})
+    assert r["valid?"] is True and r["range"] == [1, 2]
+
+    hist2 = History([
+        op("invoke", "generate", None), op("ok", "generate", 1),
+        op("invoke", "generate", None), op("ok", "generate", 1),
+    ])
+    r2 = c.unique_ids().check({}, hist2, {})
+    assert r2["valid?"] is False and r2["duplicated"] == {1: 2}
+
+
+# -- counter ---------------------------------------------------------------------
+
+def test_counter_valid():
+    hist = History([
+        op("invoke", "add", 1, process=0),
+        op("ok", "add", 1, process=0),
+        op("invoke", "read", None, process=1),
+        op("ok", "read", 1, process=1),
+    ])
+    r = c.counter().check({}, hist, {})
+    assert r["valid?"] is True
+    assert r["reads"] == [[1, 1, 1]]
+
+
+def test_counter_pending_add_widens_bounds():
+    hist = History([
+        op("invoke", "add", 1, process=0),
+        op("info", "add", 1, process=0),      # maybe applied
+        op("invoke", "read", None, process=1),
+        op("ok", "read", 1, process=1),       # saw it: fine
+        op("invoke", "read", None, process=2),
+        op("ok", "read", 0, process=2),       # didn't: also fine
+    ])
+    r = c.counter().check({}, hist, {})
+    assert r["valid?"] is True
+
+
+def test_counter_invalid():
+    hist = History([
+        op("invoke", "add", 1, process=0),
+        op("ok", "add", 1, process=0),
+        op("invoke", "read", None, process=1),
+        op("ok", "read", 5, process=1),
+    ])
+    r = c.counter().check({}, hist, {})
+    assert r["valid?"] is False
+    assert r["errors"] == [[1, 5, 1]]
+
+
+def test_counter_failed_add_not_applied():
+    hist = History([
+        op("invoke", "add", 1, process=0),
+        op("fail", "add", 1, process=0),
+        op("invoke", "read", None, process=1),
+        op("ok", "read", 0, process=1),
+    ])
+    r = c.counter().check({}, hist, {})
+    assert r["valid?"] is True
+
+
+# -- log file pattern --------------------------------------------------------------
+
+def test_log_file_pattern(tmp_path):
+    test = {"name": "t", "start-time": "now", "store-dir": str(tmp_path),
+            "nodes": ["n1", "n2"]}
+    d = tmp_path / "t" / "now" / "n1"
+    d.mkdir(parents=True)
+    (d / "db.log").write_text("all fine\npanic: invariant violation\n")
+    r = c.log_file_pattern(r"panic: \w+", "db.log").check(test, History([]),
+                                                          {})
+    assert r["valid?"] is False and r["count"] == 1
+    assert r["matches"][0]["node"] == "n1"

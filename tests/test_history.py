@@ -93,6 +93,9 @@ def test_encode_ops_register():
     assert ops.kind[2] == h.KIND_INFO
     assert ops.ret[2] == h.PENDING_RET
     assert ops.process.dtype == np.int32
+    assert ops.inv.dtype == np.int32 and ops.ret.dtype == np.int32
+    # PENDING_RET must survive an int32 cast (TPU has no int64)
+    assert np.int32(h.PENDING_RET) == h.PENDING_RET > 2**30
 
 
 def test_encode_ops_cas_values():

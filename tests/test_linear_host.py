@@ -1,0 +1,145 @@
+"""Host linearizability oracle tests on literal histories (these become the
+golden corpus for the TPU kernel)."""
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker.linear import analysis_host, linearizable
+from jepsen_tpu.history import History
+
+
+def op(type, f, value, process=0, **kw):
+    return {"type": type, "f": f, "value": value, "process": process,
+            "time": 0, **kw}
+
+
+def test_trivial_valid():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 0), op("ok", "read", 1, 0),
+    ])
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True
+
+
+def test_trivial_invalid():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 0), op("ok", "read", 2, 0),
+    ])
+    a = analysis_host(m.cas_register(), hist)
+    assert a["valid?"] is False
+    assert a["op"]["value"] == 2
+
+
+def test_concurrent_read_during_write_either_value_ok():
+    # read overlaps the write: may see old or new
+    for seen in (None, 1):
+        hist = History([
+            op("invoke", "write", 0, 0), op("ok", "write", 0, 0),
+            op("invoke", "write", 1, 0),
+            op("invoke", "read", None, 1),
+            op("ok", "read", seen if seen is not None else 0, 1),
+            op("ok", "write", 1, 0),
+        ])
+        assert analysis_host(m.cas_register(), hist)["valid?"] is True
+
+
+def test_read_after_write_completes_must_see_it():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 1), op("ok", "read", None, 1),
+    ])
+    # read value None matches anything: valid
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True
+    hist2 = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 0), op("ok", "write", 2, 0),
+        op("invoke", "read", 1, 1), op("ok", "read", 1, 1),
+    ])
+    assert analysis_host(m.cas_register(), hist2)["valid?"] is False
+
+
+def test_crashed_write_may_take_effect():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 1), op("info", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 2, 2),
+    ])
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True
+
+
+def test_crashed_write_may_never_take_effect():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 1), op("info", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 1, 2),
+    ])
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True
+
+
+def test_failed_op_must_not_take_effect():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 1), op("fail", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 2, 2),
+    ])
+    assert analysis_host(m.cas_register(), hist)["valid?"] is False
+
+
+def test_cas_semantics():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "cas", (1, 3), 1), op("ok", "cas", (1, 3), 1),
+        op("invoke", "read", None, 0), op("ok", "read", 3, 0),
+    ])
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True
+    bad = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "cas", (2, 3), 1), op("ok", "cas", (2, 3), 1),
+    ])
+    assert analysis_host(m.cas_register(), bad)["valid?"] is False
+
+
+def test_mutex():
+    good = History([
+        op("invoke", "acquire", None, 0), op("ok", "acquire", None, 0),
+        op("invoke", "release", None, 0), op("ok", "release", None, 0),
+        op("invoke", "acquire", None, 1), op("ok", "acquire", None, 1),
+    ])
+    assert analysis_host(m.mutex(), good)["valid?"] is True
+    bad = History([
+        op("invoke", "acquire", None, 0), op("ok", "acquire", None, 0),
+        op("invoke", "acquire", None, 1), op("ok", "acquire", None, 1),
+    ])
+    assert analysis_host(m.mutex(), bad)["valid?"] is False
+
+
+def test_overlapping_writes_reads_classic():
+    # Knossos-style example: two concurrent writes, read sees second
+    hist = History([
+        op("invoke", "write", 1, 0),
+        op("invoke", "write", 2, 1),
+        op("ok", "write", 1, 0),
+        op("ok", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 1, 2),
+    ])
+    # order w2 then w1 leaves 1: valid
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True
+
+
+def test_checker_interface():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 0), op("ok", "read", 1, 0),
+    ])
+    chk = linearizable({"model": m.cas_register(), "algorithm": "linear"})
+    r = chk.check({}, hist, {})
+    assert r["valid?"] is True
+    assert len(r["configs"]) <= 10
+
+
+def test_nemesis_ops_ignored():
+    hist = History([
+        op("invoke", "write", 1, 0),
+        op("info", "start-partition", None, "nemesis"),
+        op("ok", "write", 1, 0),
+    ])
+    assert analysis_host(m.cas_register(), hist)["valid?"] is True

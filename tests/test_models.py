@@ -1,0 +1,73 @@
+from jepsen_tpu import models as m
+
+
+def op(f, value=None):
+    return {"f": f, "value": value}
+
+
+def test_cas_register():
+    r = m.cas_register()
+    r = r.step(op("write", 3))
+    assert r == m.CASRegister(3)
+    assert r.step(op("read", 3)) == r
+    assert r.step(op("read", None)) == r
+    assert m.is_inconsistent(r.step(op("read", 4)))
+    r2 = r.step(op("cas", (3, 5)))
+    assert r2 == m.CASRegister(5)
+    assert m.is_inconsistent(r.step(op("cas", (4, 5))))
+
+
+def test_register():
+    r = m.register(1)
+    assert m.is_inconsistent(r.step(op("read", 2)))
+    assert r.step(op("write", 2)).step(op("read", 2)) == m.Register(2)
+
+
+def test_mutex():
+    x = m.mutex()
+    held = x.step(op("acquire"))
+    assert held == m.Mutex(True)
+    assert m.is_inconsistent(held.step(op("acquire")))
+    assert held.step(op("release")) == m.Mutex(False)
+    assert m.is_inconsistent(x.step(op("release")))
+
+
+def test_unordered_queue():
+    q = m.unordered_queue()
+    q = q.step(op("enqueue", 1)).step(op("enqueue", 2))
+    q2 = q.step(op("dequeue", 2))
+    assert not m.is_inconsistent(q2)
+    assert m.is_inconsistent(q2.step(op("dequeue", 2)))
+    # duplicates allowed
+    q3 = m.unordered_queue().step(op("enqueue", 7)).step(op("enqueue", 7))
+    q3 = q3.step(op("dequeue", 7)).step(op("dequeue", 7))
+    assert not m.is_inconsistent(q3)
+
+
+def test_fifo_queue():
+    q = m.fifo_queue().step(op("enqueue", 1)).step(op("enqueue", 2))
+    assert m.is_inconsistent(q.step(op("dequeue", 2)))
+    q = q.step(op("dequeue", 1))
+    assert q == m.FIFOQueue((2,))
+
+
+def test_device_step_register_matches_model():
+    from jepsen_tpu.history import F_CAS, F_READ, F_WRITE, NIL
+    # write
+    ok, s = m.device_step_register(NIL, F_WRITE, 5, NIL, cas=True)
+    assert ok and s == 5
+    # read match/mismatch/nil
+    assert m.device_step_register(5, F_READ, 5, NIL, True)[0]
+    assert not m.device_step_register(5, F_READ, 6, NIL, True)[0]
+    assert m.device_step_register(5, F_READ, NIL, NIL, True)[0]
+    # cas
+    ok, s = m.device_step_register(5, F_CAS, 5, 9, True)
+    assert ok and s == 9
+    ok, _ = m.device_step_register(5, F_CAS, 4, 9, True)
+    assert not ok
+
+
+def test_device_state():
+    assert m.cas_register(4).device_state() == 4
+    assert m.cas_register().device_state() == -1
+    assert m.mutex().device_state() == 0

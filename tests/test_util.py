@@ -54,37 +54,64 @@ def test_await_fn():
 
 
 def test_integer_interval_set_str():
-    assert util.integer_interval_set_str([1, 3, 4, 5, 7]) == "#{1 3-5 7}"
+    assert util.integer_interval_set_str([1, 3, 4, 5, 7]) == "#{1 3..5 7}"
     assert util.integer_interval_set_str([]) == "#{}"
-    assert util.integer_interval_set_str([1, 2]) == "#{1 2}"
+    assert util.integer_interval_set_str([1, 2]) == "#{1..2}"
+    assert util.integer_interval_set_str([-5, -4, -3]) == "#{-5..-3}"
+
+
+def nem(f, time):
+    return {"process": "nemesis", "type": "info", "f": f, "value": None,
+            "time": time}
 
 
 def test_nemesis_intervals():
-    hist = [
-        {"process": "nemesis", "type": "info", "f": "start-partition",
-         "value": None, "time": 1},
-        {"process": 0, "type": "invoke", "f": "read", "value": None,
-         "time": 2},
-        {"process": "nemesis", "type": "info", "f": "stop-partition",
-         "value": None, "time": 3},
-        {"process": "nemesis", "type": "info", "f": "start-kill",
-         "value": None, "time": 4},
-    ]
+    # nemesis ops arrive in invoke/complete pairs; one stop closes all
+    # open starts (reference util.clj:745-750 example)
+    hist = [nem("start", 1), nem("start", 2),    # pair 1 (s1)
+            nem("start", 3), nem("start", 4),    # pair 2 (s2)
+            nem("stop", 5), nem("stop", 6)]      # stop pair
     ivals = util.nemesis_intervals(hist)
-    assert len(ivals) == 2
-    assert ivals[0][0]["f"] == "start-partition"
-    assert ivals[0][1]["f"] == "stop-partition"
-    assert ivals[1] == (hist[3], None)
+    assert [(a["time"], b["time"] if b else None) for a, b in ivals] == \
+        [(1, 5), (2, 6), (3, 5), (4, 6)]
+
+
+def test_nemesis_intervals_unclosed():
+    hist = [nem("start", 1), nem("start", 2)]
+    ivals = util.nemesis_intervals(hist)
+    assert ivals == [(hist[0], None), (hist[1], None)]
+
+
+def test_nemesis_intervals_custom_fs():
+    hist = [nem("start-partition", 1), nem("start-partition", 2),
+            nem("stop-partition", 3), nem("stop-partition", 4)]
+    ivals = util.nemesis_intervals(hist, {"start-partition"},
+                                   {"stop-partition"})
+    assert [(a["time"], b["time"]) for a, b in ivals] == [(1, 3), (2, 4)]
 
 
 def test_history_latencies():
     hist = [
         {"process": 0, "type": "invoke", "f": "read", "value": None,
          "time": 100},
+        {"process": 1, "type": "invoke", "f": "write", "value": 2,
+         "time": 150},
         {"process": 0, "type": "ok", "f": "read", "value": 1, "time": 350},
     ]
-    lats = util.history_latencies(hist)
-    assert len(lats) == 1 and lats[0]["latency"] == 250
+    out = util.history_latencies(hist)
+    assert len(out) == 3                       # full history preserved
+    assert out[0]["latency"] == 250            # invocation annotated
+    assert out[0]["completion"]["type"] == "ok"
+    assert out[2]["latency"] == 250            # completion annotated
+    assert "latency" not in out[1]             # pending invoke untouched
+
+
+def test_relative_time_nesting():
+    with util.relative_time():
+        with util.relative_time():
+            util.relative_time_nanos()
+        # inner exit must restore the outer origin
+        assert util.relative_time_nanos() >= 0
 
 
 def test_majority_and_quantile():
