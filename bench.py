@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Headline benchmark: linearizability verification throughput on TPU.
+
+The reference's CPU Knossos checker needs a 32 GB JVM heap
+(`jepsen/project.clj:38`) and times out (~1 h) on 10k-op histories
+(BASELINE.md north-star). This benchmark checks a 10k-op concurrent CAS
+register history with the TPU WGL kernel and reports verified ops/sec.
+
+vs_baseline is the speedup over the CPU-Knossos north-star baseline of
+10_000 ops / 3600 s (the 1 h timeout).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+N_OPS = 10_000
+CONCURRENCY = 5
+BASELINE_OPS_PER_SEC = N_OPS / 3600.0  # CPU knossos: 1 h timeout on 10k ops
+
+
+def main() -> int:
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    hist = synth.register_history(N_OPS, concurrency=CONCURRENCY, values=5,
+                                  crash_rate=0.002, seed=45100)
+    model = models.cas_register()
+
+    # First call compiles (~20-40 s on TPU); benchmark the steady state.
+    a = analysis_tpu(model, hist)
+    assert a["valid?"] is True, f"benchmark history must verify: {a}"
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        a = analysis_tpu(model, hist)
+        best = min(best, time.monotonic() - t0)
+    assert a["valid?"] is True
+
+    value = N_OPS / best
+    print(json.dumps({
+        "metric": ("linearizability verification throughput, 10k-op "
+                   "concurrent CAS-register history (WGL frontier search)"),
+        "value": round(value, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(value / BASELINE_OPS_PER_SEC, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

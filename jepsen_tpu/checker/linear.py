@@ -155,6 +155,10 @@ class Linearizable(Checker):
         algo = self.algorithm
         if algo in ("linear", "wgl", "competition"):
             algo = "auto"
+        elif algo == "tpu-wgl":
+            algo = "tpu"
+        if algo not in ("auto", "tpu", "host"):
+            raise ValueError(f"unknown linearizability algorithm {algo!r}")
         if algo in ("auto", "tpu"):
             if self.model.device_model is not None:
                 try:

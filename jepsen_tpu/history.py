@@ -295,15 +295,25 @@ def default_register_codec(o: dict) -> tuple[int, int, int]:
 
 def encode_ops(h: History,
                codec: Callable[[dict], tuple[int, int, int]]
-               = default_register_codec) -> OpArray:
+               = default_register_codec,
+               drop_pending: frozenset | None = None) -> OpArray:
     """Lower a history to an OpArray for the device checkers.
 
     Pairing/semantics follow knossos: each client invoke pairs with the next
     completion from the same process; :fail pairs are dropped; :info ops are
-    pending forever (ret = PENDING_RET); pending reads are dropped; the
-    *completion's* value is authoritative for :ok ops (a read's observed
-    value arrives on the :ok op).
+    pending forever (ret = PENDING_RET); the *completion's* value is
+    authoritative for :ok ops (a read's observed value arrives on the :ok
+    op).
+
+    drop_pending: f-codes whose pending (crashed) ops constrain nothing and
+    may be elided. This is codec-specific — f-code meanings differ per codec
+    (mutex 'acquire' is 0 too) — so the default only drops reads for the
+    default register codec and nothing otherwise; keeping a droppable
+    pending op is always sound, just slower.
     """
+    if drop_pending is None:
+        drop_pending = (frozenset({F_READ})
+                        if codec is default_register_codec else frozenset())
     if h.ops and "index" not in h.ops[0]:
         h = h.index()
     h = h.client_ops()
@@ -317,9 +327,10 @@ def encode_ops(h: History,
         if comp is not None and is_fail(comp):
             continue  # did not take effect
         if comp is None or is_info(comp):
-            # Pending forever. Crashed reads constrain nothing: drop.
+            # Pending forever. Ops whose f is in drop_pending constrain
+            # nothing when pending (e.g. reads) and are elided.
             f, a, b = codec(o)
-            if f == F_READ:
+            if f in drop_pending:
                 continue
             rows.append((f, a, b, KIND_INFO, i, PENDING_RET,
                          o["process"], o.get("index", i)))

@@ -2,14 +2,25 @@
 
 Forces JAX onto a virtual 8-device CPU mesh so every sharding/pjit path is
 exercised hermetically (no TPU needed), matching how the driver dry-runs the
-multi-chip path. Must run before jax is imported anywhere.
+multi-chip path.
+
+Some session interpreters pre-import jax at startup (a sitecustomize hook
+registers a real-TPU PJRT plugin and bakes ``jax_platforms="axon,cpu"``
+into the already-imported config), so setting ``JAX_PLATFORMS`` in the
+environment here is too late — we must also rewrite the live config.
+``XLA_FLAGS`` is still read from the environment at CPU-client creation,
+which is lazy, so setting it here is early enough.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,195 @@
+"""TPU WGL kernel golden tests: the device checker must agree with the host
+oracle on every history (the SURVEY's 'golden tests for the TPU kernels:
+same history arrays in, same verdicts out')."""
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import synth
+from jepsen_tpu.checker.linear import analysis_host
+from jepsen_tpu.checker.wgl import (SlotOverflow, analysis_tpu,
+                                    analysis_tpu_batch, build_entries,
+                                    check_batch_sharded,
+                                    encode_ops_for_model)
+from jepsen_tpu.history import History
+
+
+def op(type, f, value, process=0, time=0):
+    return {"type": type, "f": f, "value": value, "process": process,
+            "time": time}
+
+
+SMALL = dict(frontier=128, slots=32)
+
+
+# -- literal corpus (mirrors test_linear_host) -------------------------------
+
+CORPUS = [
+    ("valid write-read", True, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 0), op("ok", "read", 1, 0)]),
+    ("stale read", False, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 0), op("ok", "read", 2, 0)]),
+    ("concurrent read old", True, [
+        op("invoke", "write", 0, 0), op("ok", "write", 0, 0),
+        op("invoke", "write", 1, 0),
+        op("invoke", "read", None, 1), op("ok", "read", 0, 1),
+        op("ok", "write", 1, 0)]),
+    ("concurrent read new", True, [
+        op("invoke", "write", 0, 0), op("ok", "write", 0, 0),
+        op("invoke", "write", 1, 0),
+        op("invoke", "read", None, 1), op("ok", "read", 1, 1),
+        op("ok", "write", 1, 0)]),
+    ("read after second write", False, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 0), op("ok", "write", 2, 0),
+        op("invoke", "read", 1, 1), op("ok", "read", 1, 1)]),
+    ("crashed write applied", True, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 1), op("info", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 2, 2)]),
+    ("crashed write skipped", True, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 1), op("info", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 1, 2)]),
+    ("failed write must not apply", False, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 2, 1), op("fail", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 2, 2)]),
+    ("cas chain", True, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "cas", (1, 3), 1), op("ok", "cas", (1, 3), 1),
+        op("invoke", "read", None, 0), op("ok", "read", 3, 0)]),
+    ("impossible cas", False, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "cas", (2, 3), 1), op("ok", "cas", (2, 3), 1)]),
+    ("two concurrent writes read first", True, [
+        op("invoke", "write", 1, 0),
+        op("invoke", "write", 2, 1),
+        op("ok", "write", 1, 0),
+        op("ok", "write", 2, 1),
+        op("invoke", "read", None, 2), op("ok", "read", 1, 2)]),
+    ("late read of crashed write", True, [
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "write", 9, 3), op("info", "write", 9, 3),
+        op("invoke", "write", 2, 0), op("ok", "write", 2, 0),
+        op("invoke", "read", None, 1), op("ok", "read", 9, 1)]),
+    ("empty", True, []),
+]
+
+
+@pytest.mark.parametrize("name,expect,ops",
+                         CORPUS, ids=[c[0] for c in CORPUS])
+def test_corpus_register(name, expect, ops):
+    hist = History(ops)
+    a = analysis_tpu(m.cas_register(), hist, **SMALL)
+    assert a["valid?"] is expect, a
+    # and it agrees with the host oracle
+    assert analysis_host(m.cas_register(), hist)["valid?"] is expect
+
+
+def test_failing_op_diagnosis():
+    hist = History([
+        op("invoke", "write", 1, 0), op("ok", "write", 1, 0),
+        op("invoke", "read", None, 1), op("ok", "read", 2, 1)])
+    a = analysis_tpu(m.cas_register(), hist, **SMALL)
+    assert a["valid?"] is False
+    assert a["op"]["f"] == "read" and a["op"]["value"] == 2
+
+
+def test_mutex_on_device():
+    good = History([
+        op("invoke", "acquire", None, 0), op("ok", "acquire", None, 0),
+        op("invoke", "release", None, 0), op("ok", "release", None, 0),
+        op("invoke", "acquire", None, 1), op("ok", "acquire", None, 1)])
+    assert analysis_tpu(m.mutex(), good, **SMALL)["valid?"] is True
+    bad = History([
+        op("invoke", "acquire", None, 0), op("ok", "acquire", None, 0),
+        op("invoke", "acquire", None, 1), op("ok", "acquire", None, 1)])
+    assert analysis_tpu(m.mutex(), bad, **SMALL)["valid?"] is False
+
+
+def test_pending_acquire_not_dropped():
+    # a crashed acquire may have taken the lock: a later failed... rather,
+    # a later acquire succeeding is only explainable if the crashed one
+    # never applied; both verdicts valid. But a crashed acquire followed by
+    # an impossible release sequence must still be checked.
+    ops = encode_ops_for_model(m.mutex(), History([
+        op("invoke", "acquire", None, 0), op("info", "acquire", None, 0)]))
+    assert len(ops) == 1  # pending acquire kept (unlike pending reads)
+
+
+# -- randomized golden agreement --------------------------------------------
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_valid_histories(seed):
+    hist = synth.register_history(60, concurrency=4, values=4,
+                                  crash_rate=0.05, seed=seed)
+    a = analysis_tpu(m.cas_register(), hist, **SMALL)
+    assert a["valid?"] is True, a
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_corrupted_histories(seed):
+    hist = synth.corrupt(
+        synth.register_history(60, concurrency=4, values=4,
+                               crash_rate=0.05, seed=seed), seed)
+    a = analysis_tpu(m.cas_register(), hist, **SMALL)
+    host = analysis_host(m.cas_register(), hist)
+    assert a["valid?"] is host["valid?"] is False
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_agreement_mutex(seed):
+    hist = synth.mutex_history(40, concurrency=3, seed=seed)
+    a = analysis_tpu(m.mutex(), hist, **SMALL)
+    host = analysis_host(m.mutex(), hist)
+    assert a["valid?"] is host["valid?"], (a, host)
+
+
+# -- batching & sharding ------------------------------------------------------
+
+def test_batch():
+    hists = [synth.register_history(40, concurrency=3, seed=s)
+             for s in range(4)]
+    hists.append(synth.corrupt(hists[0]))
+    rs = analysis_tpu_batch(m.cas_register(), hists, frontier=128, slots=16)
+    assert [r["valid?"] for r in rs] == [True, True, True, True, False]
+    assert rs[4].get("op") is not None
+
+
+def test_sharded_over_mesh():
+    import jax
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    hists = [synth.register_history(30, concurrency=3, seed=s)
+             for s in range(16)]
+    all_ok, per_key = check_batch_sharded(m.cas_register(), hists,
+                                          frontier=128, slots=16)
+    assert all_ok and per_key.all()
+    hists[5] = synth.corrupt(hists[5])
+    all_ok, per_key = check_batch_sharded(m.cas_register(), hists,
+                                          frontier=128, slots=16)
+    assert not all_ok
+    assert not per_key[5] and per_key[[i for i in range(16) if i != 5]].all()
+
+
+# -- slot machinery -----------------------------------------------------------
+
+def test_slot_overflow_detection():
+    hist = History(
+        [op("invoke", "write", i, i) for i in range(10)])  # 10 pending
+    ops = encode_ops_for_model(m.cas_register(), hist)
+    with pytest.raises(SlotOverflow):
+        build_entries(ops, 4)
+
+
+def test_slot_overflow_escalates_transparently():
+    # 8 fully-concurrent writes need 8 slots; we hand the checker 4 and it
+    # must escalate. frontier 4096 covers all 2^8*8 reachable configs, so
+    # no truncation nondeterminism.
+    hist = History(
+        [op("invoke", "write", i, i) for i in range(8)]
+        + [op("ok", "write", i, i) for i in range(8)])
+    a = analysis_tpu(m.cas_register(), hist, frontier=4096, slots=4)
+    assert a["valid?"] is True
