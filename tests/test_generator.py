@@ -1,0 +1,310 @@
+"""Generator system tests, mirroring the reference's simulator-first test
+strategy (`jepsen/test/jepsen/generator_test.clj`): deterministic
+simulation with a pinned RNG, exact assertions on op streams."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import simulate as sim
+
+
+def fs(history):
+    return [o.get("f") for o in history]
+
+
+def times(history):
+    return [o["time"] for o in history]
+
+
+# -- lifting ----------------------------------------------------------------
+
+def test_dict_is_one_shot_generator():
+    h = sim.quick({"f": "write", "value": 1})
+    assert len(h) == 1
+    o = h[0]
+    assert o["f"] == "write" and o["value"] == 1
+    assert o["type"] == "invoke"
+    assert o["time"] == 0
+    assert o["process"] in (0, 1, "nemesis")
+
+
+def test_fn_generator_is_called_repeatedly():
+    n = {"count": 0}
+
+    def g():
+        n["count"] += 1
+        if n["count"] <= 3:
+            return {"f": "read"}
+        return None
+
+    h = sim.quick(g)
+    assert fs(h) == ["read"] * 3
+
+
+def test_fn_generator_with_test_ctx_arity():
+    def g(test, ctx):
+        return {"f": "read", "value": ctx.time}
+
+    h = sim.quick(gen.limit(2, g))
+    assert fs(h) == ["read", "read"]
+
+
+def test_sequence_runs_elements_in_order():
+    h = sim.quick([{"f": "a"}, {"f": "b"}, {"f": "c"}])
+    assert fs(h) == ["a", "b", "c"]
+
+
+def test_nested_sequences_flatten():
+    h = sim.quick([[{"f": "a"}, {"f": "b"}], {"f": "c"}])
+    assert fs(h) == ["a", "b", "c"]
+
+
+def test_none_is_exhausted():
+    assert sim.quick(None) == []
+
+
+def test_none_inside_sequence_skipped():
+    # None elements are exhausted generators; the sequence moves past them
+    h = sim.quick([None, {"f": "a"}])
+    assert fs(h) == ["a"]
+
+
+# -- limit / once / repeat / cycle ------------------------------------------
+
+def test_limit():
+    h = sim.quick(gen.limit(3, lambda: {"f": "read"}))
+    assert fs(h) == ["read"] * 3
+
+
+def test_once():
+    h = sim.quick(gen.once(lambda: {"f": "read"}))
+    assert fs(h) == ["read"]
+
+
+def test_repeat_of_one_shot_dict():
+    h = sim.quick(gen.limit(4, gen.repeat({"f": "w"})))
+    assert fs(h) == ["w"] * 4
+
+
+def test_repeat_bounded():
+    h = sim.quick(gen.repeat(2, {"f": "w"}))
+    assert fs(h) == ["w", "w"]
+
+
+def test_cycle_restarts_exhausted_generator():
+    h = sim.quick(gen.cycle(3, [{"f": "a"}, {"f": "b"}]))
+    assert fs(h) == ["a", "b"] * 3
+
+
+# -- map / f-map / filter ----------------------------------------------------
+
+def test_map_transforms_ops():
+    def bump(o):
+        o = dict(o)
+        o["value"] = o["value"] + 1
+        return o
+    h = sim.quick(gen.map(bump, gen.limit(2, gen.repeat({"f": "w", "value": 1}))))
+    assert [o["value"] for o in h] == [2, 2]
+
+
+def test_f_map_renames_fs():
+    h = sim.quick(gen.f_map({"start": "start-partition"},
+                            gen.once({"f": "start"})))
+    assert fs(h) == ["start-partition"]
+
+
+def test_filter_drops_ops():
+    seq = [{"f": "a", "value": i} for i in range(6)]
+    h = sim.quick(gen.filter(lambda o: o["value"] % 2 == 0, seq))
+    assert [o["value"] for o in h] == [0, 2, 4]
+
+
+# -- thread routing ----------------------------------------------------------
+
+def test_clients_excludes_nemesis():
+    h = sim.quick(gen.clients(gen.limit(10, {"f": "r"})))
+    assert all(o["process"] != "nemesis" for o in h)
+
+
+def test_nemesis_only():
+    h = sim.quick(gen.nemesis(gen.limit(4, {"f": "kill"})))
+    assert all(o["process"] == "nemesis" for o in h)
+
+
+def test_clients_nemesis_two_arity_routes_both():
+    h = sim.quick(gen.clients(gen.limit(6, gen.repeat({"f": "r"})),
+                              gen.limit(2, gen.repeat({"f": "kill"}))))
+    cl = [o for o in h if o["process"] != "nemesis"]
+    nm = [o for o in h if o["process"] == "nemesis"]
+    assert fs(cl) == ["r"] * 6 and fs(nm) == ["kill"] * 2
+
+
+def test_each_thread_gives_every_thread_a_copy():
+    h = sim.quick(gen.each_thread({"f": "hi"}))
+    # 2 workers + nemesis, one op each
+    assert sorted(str(o["process"]) for o in h) == ["0", "1", "nemesis"]
+
+
+def test_reserve_partitions_threads():
+    ctx = sim.n_plus_nemesis_context(5)
+    h = sim.quick(ctx, gen.clients(gen.reserve(
+        2, gen.limit(10, gen.repeat({"f": "w"})),
+        gen.limit(10, gen.repeat({"f": "r"})))))
+    w_threads = {o["process"] for o in h if o["f"] == "w"}
+    r_threads = {o["process"] for o in h if o["f"] == "r"}
+    assert w_threads <= {0, 1}
+    assert r_threads <= {2, 3, 4}
+    assert len(h) == 20
+
+
+# -- any / mix / flip-flop ---------------------------------------------------
+
+def test_any_draws_from_all():
+    h = sim.quick(gen.any(gen.limit(2, gen.repeat({"f": "a"})),
+                          gen.limit(2, gen.repeat({"f": "b"}))))
+    assert sorted(fs(h)) == ["a", "a", "b", "b"]
+
+
+def test_mix_draws_from_all_and_exhausts():
+    h = sim.quick(gen.mix([gen.limit(5, gen.repeat({"f": "a"})),
+                           gen.limit(5, gen.repeat({"f": "b"}))]))
+    assert sorted(fs(h)) == ["a"] * 5 + ["b"] * 5
+
+
+def test_flip_flop_alternates():
+    h = sim.quick(gen.flip_flop(gen.limit(3, gen.repeat({"f": "a"})),
+                                gen.limit(5, gen.repeat({"f": "b"}))))
+    assert fs(h) == ["a", "b", "a", "b", "a", "b"]
+
+
+# -- timing ------------------------------------------------------------------
+
+def test_stagger_spaces_ops_out():
+    h = sim.perfect(gen.stagger(1, gen.limit(10, gen.repeat({"f": "r"}))))
+    ts = times(h)
+    assert ts == sorted(ts)
+    assert ts[-1] > 0
+    # mean spacing should be on the order of 1 s (2 s max per gap)
+    gaps = [b - a for a, b in zip(ts, ts[1:])]
+    assert all(0 <= g <= 2_000_000_000 for g in gaps)
+
+
+def test_delay_spaces_exactly():
+    h = sim.perfect(gen.delay(1, gen.limit(4, gen.repeat({"f": "r"}))))
+    ts = times(h)
+    assert ts == [0, 1_000_000_000, 2_000_000_000, 3_000_000_000]
+
+
+def test_time_limit_cuts_off():
+    h = sim.perfect(gen.time_limit(2, gen.delay(1, gen.repeat({"f": "r"}))))
+    ts = times(h)
+    assert ts == [0, 1_000_000_000]
+
+
+def test_sleep_op():
+    h = sim.quick_ops(gen.once(gen.sleep(2)))
+    assert h[0]["type"] in ("sleep", "ok")
+    assert h[0]["value"] == 2
+
+
+# -- phasing -----------------------------------------------------------------
+
+def test_phases_run_in_order():
+    h = sim.perfect(gen.phases(gen.limit(3, gen.repeat({"f": "a"})),
+                               gen.limit(3, gen.repeat({"f": "b"}))))
+    assert fs(h) == ["a"] * 3 + ["b"] * 3
+
+
+def test_then_runs_b_first():
+    h = sim.perfect(gen.then(gen.once({"f": "after"}),
+                             gen.limit(2, gen.repeat({"f": "before"}))))
+    assert fs(h) == ["before", "before", "after"]
+
+
+def test_synchronize_waits_for_all_threads():
+    # With perfect latency, ops overlap; synchronize must still order
+    # phase b strictly after all of a's completions.
+    full = sim.perfect_star(gen.phases(gen.limit(4, gen.repeat({"f": "a"})),
+                                       gen.once({"f": "b"})))
+    b_invoke = next(o for o in full
+                    if o["f"] == "b" and o["type"] == "invoke")
+    a_completions = [o for o in full
+                     if o["f"] == "a" and o["type"] == "ok"]
+    assert all(o["time"] <= b_invoke["time"] for o in a_completions)
+
+
+# -- process limits and crash retirement -------------------------------------
+
+def test_perfect_info_retires_processes():
+    h = sim.perfect_info(gen.clients(gen.limit(6, gen.repeat({"f": "r"}))))
+    # every client op crashes; processes must be retired and replaced
+    procs = [o["process"] for o in h]
+    assert len(set(procs)) == 6  # all distinct: 0,1 then 2,3 then 4,5
+
+
+def test_process_limit_bounds_distinct_processes():
+    h = sim.perfect_info(
+        gen.process_limit(4, gen.clients(gen.repeat({"f": "r"}))))
+    procs = {o["process"] for o in h}
+    assert len(procs) <= 4
+
+
+# -- until-ok ----------------------------------------------------------------
+
+def test_until_ok_stops_after_first_ok():
+    # imperfect cycles fail -> info -> ok per thread
+    h = sim.imperfect(gen.until_ok(gen.repeat({"f": "r"})))
+    oks = [o for o in h if o["type"] == "ok"]
+    assert len(oks) >= 1
+    first_ok_t = oks[0]["time"]
+    later_invokes = [o for o in h
+                     if o["type"] == "invoke" and o["time"] > first_ok_t]
+    assert later_invokes == []
+
+
+# -- cycle-times -------------------------------------------------------------
+
+def test_cycle_times_windows():
+    h = sim.perfect(gen.time_limit(
+        4, gen.cycle_times(1, gen.delay(0.25, gen.repeat({"f": "a"})),
+                           1, gen.delay(0.25, gen.repeat({"f": "b"})))))
+    for o in h:
+        window = (o["time"] // 1_000_000_000) % 2
+        assert o["f"] == ("a" if window == 0 else "b"), (o, window)
+
+
+# -- validate ----------------------------------------------------------------
+
+def test_validate_rejects_busy_process():
+    class Bad(gen.Gen):
+        def op(self, test, ctx):
+            return {"type": "invoke", "process": 99, "time": 0,
+                    "f": "x"}, None
+    with pytest.raises(gen.InvalidOp):
+        sim.quick(Bad())
+
+
+def test_validate_rejects_bad_type():
+    class Bad(gen.Gen):
+        def op(self, test, ctx):
+            o = gen.fill_in_op({"f": "x"}, ctx)
+            o["type"] = "wat"
+            return o, None
+    with pytest.raises(gen.InvalidOp):
+        sim.quick(Bad())
+
+
+# -- determinism -------------------------------------------------------------
+
+def test_simulation_is_deterministic():
+    g = gen.stagger(0.1, gen.limit(30, gen.mix([{"f": "a"}, {"f": "b"}])))
+    h1 = sim.perfect(g)
+    h2 = sim.perfect(g)
+    assert h1 == h2
+
+
+def test_friendly_exceptions_wraps():
+    def boom():
+        raise RuntimeError("nope")
+    with pytest.raises(gen.GenException):
+        sim.quick(gen.friendly_exceptions(boom))
