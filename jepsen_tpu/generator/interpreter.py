@@ -1,0 +1,239 @@
+"""The interpreter: turns a pure generator into a real concurrent history
+(reference `jepsen/src/jepsen/generator/interpreter.clj`).
+
+One worker thread per logical thread (concurrency clients + the nemesis),
+each fed through a 1-slot queue; a single-threaded scheduler loop owns the
+context and the generator, polls completions at microsecond granularity
+(`max-pending-interval` 1000 us, `interpreter.clj:166-170`), asks the
+generator for ops, dispatches them, and journals invocations and
+completions into the history.
+
+Worker behavior (`interpreter.clj:99-164`):
+  * any Throwable from a client invoke becomes an :info op (the op is
+    indeterminate — it may or may not have taken effect),
+  * crashed (non-nemesis) processes are retired and replaced with fresh
+    process ids (`:233-236`),
+  * crashed clients are closed and reopened for the new process, unless
+    the client is `reusable` (`ClientWorker`, `:33-67`),
+  * :sleep and :log ops are handled in the worker and kept out of the
+    history (`goes-in-history?`, `:171-178`).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+from typing import Any, Optional
+
+from .. import client as jclient
+from ..history import History
+from ..util import relative_time_nanos
+from . import (NEMESIS, PENDING, context, friendly_exceptions,
+               next_process, process_to_thread, validate)
+from . import op as gen_op
+from . import update as gen_update
+
+LOG = logging.getLogger("jepsen_tpu.interpreter")
+
+MAX_PENDING_INTERVAL_US = 1000
+
+
+class Worker:
+    """Stateful per-thread executor; all calls come from one thread
+    (`interpreter.clj:19-31`)."""
+
+    def open(self, test: dict, wid) -> "Worker":
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        raise NotImplementedError
+
+    def close(self, test: dict) -> None:
+        pass
+
+
+class ClientWorker(Worker):
+    """Owns the client for one thread; reopens it per fresh process unless
+    the client is reusable (`interpreter.clj:33-67`)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self.process = None
+        self.client: Optional[jclient.Client] = None
+
+    def invoke(self, test, op):
+        while True:
+            if self.process == op["process"] and self.client is not None:
+                return self.client.invoke(test, op)
+            if self.client is not None and \
+                    jclient.is_reusable(self.client, test):
+                self.process = op["process"]
+                continue
+            # new process, new client
+            self.close(test)
+            try:
+                self.client = jclient.validate(test["client"]).open(
+                    test, self.node)
+                self.process = op["process"]
+            except Exception as e:
+                LOG.warning("error opening client: %s", e)
+                self.client = None
+                out = dict(op)
+                out["type"] = "fail"
+                out["error"] = ["no-client", str(e)]
+                return out
+
+    def close(self, test):
+        if self.client is not None:
+            try:
+                self.client.close(test)
+            finally:
+                self.client = None
+
+
+class NemesisWorker(Worker):
+    def invoke(self, test, op):
+        return test["nemesis"].invoke(test, op)
+
+
+class ClientNemesisWorker(Worker):
+    """Spawns ClientWorkers for integer ids (round-robin over nodes) and a
+    NemesisWorker for the nemesis (`interpreter.clj:77-95`)."""
+
+    def open(self, test, wid):
+        if isinstance(wid, int):
+            nodes = test.get("nodes") or ["local"]
+            return ClientWorker(nodes[wid % len(nodes)]).open(test, wid)
+        return NemesisWorker().open(test, wid)
+
+
+def goes_in_history(op: dict) -> bool:
+    return op.get("type") not in ("sleep", "log")
+
+
+class _WorkerThread:
+    def __init__(self, test: dict, out: queue.Queue, worker: Worker, wid):
+        self.id = wid
+        self.inbox: queue.Queue = queue.Queue(1)
+        self.test = test
+        self.out = out
+        self.worker = worker
+        self.thread = threading.Thread(
+            target=self._run, name=f"jepsen-worker-{wid}", daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        test = self.test
+        worker = self.worker.open(test, self.id)
+        try:
+            while True:
+                op = self.inbox.get()
+                t = op.get("type")
+                if t == "exit":
+                    return
+                try:
+                    if t == "sleep":
+                        _time.sleep(op["value"])
+                        self.out.put(op)
+                    elif t == "log":
+                        LOG.info("%s", op["value"])
+                        self.out.put(op)
+                    else:
+                        self.out.put(worker.invoke(test, op))
+                except BaseException as e:
+                    LOG.warning("process %r crashed: %s",
+                                op.get("process"), e)
+                    out = dict(op)
+                    out["type"] = "info"
+                    out["error"] = f"indeterminate: {e}"
+                    self.out.put(out)
+        finally:
+            worker.close(test)
+
+
+def run(test: dict) -> History:
+    """Evaluate all ops from test['generator'], applying them with
+    test['client'] / test['nemesis']. Returns the history
+    (`interpreter.clj:181-310`)."""
+    ctx = context(test)
+    completions: queue.Queue = queue.Queue()
+    workers = [_WorkerThread(test, completions, ClientNemesisWorker(), t)
+               for t in ctx.workers]
+    inboxes = {w.id: w.inbox for w in workers}
+    gen = validate(friendly_exceptions(test.get("generator")))
+    outstanding = 0
+    poll_timeout_us = 0
+    history: list = []
+
+    try:
+        while True:
+            # Completions first: they're latency-sensitive — waiting
+            # introduces false concurrency.
+            try:
+                if poll_timeout_us > 0:
+                    op2 = completions.get(timeout=poll_timeout_us / 1e6)
+                else:
+                    op2 = completions.get_nowait()
+            except queue.Empty:
+                op2 = None
+
+            if op2 is not None:
+                thread = process_to_thread(ctx, op2["process"])
+                now = relative_time_nanos()
+                op2 = dict(op2)
+                op2["time"] = now
+                ctx = ctx.with_time(now).free(thread)
+                # update sees the free thread but the *old* process so
+                # thread->process still resolves this event
+                gen = gen_update(gen, test, ctx, op2)
+                if thread != NEMESIS and op2.get("type") == "info":
+                    workers_map = dict(ctx.workers)
+                    workers_map[thread] = next_process(ctx, thread)
+                    ctx = ctx.with_workers(workers_map)
+                if goes_in_history(op2):
+                    history.append(op2)
+                outstanding -= 1
+                poll_timeout_us = 0
+                continue
+
+            now = relative_time_nanos()
+            ctx = ctx.with_time(now)
+            res = gen_op(gen, test, ctx)
+            if res is None:
+                if outstanding > 0:
+                    poll_timeout_us = MAX_PENDING_INTERVAL_US
+                    continue
+                for w in workers:
+                    w.inbox.put({"type": "exit"})
+                for w in workers:
+                    w.thread.join()
+                return History(history)
+
+            op, gen1 = res
+            if op is PENDING:
+                # keep the un-advanced generator, as the reference does
+                # (interpreter.clj:263-265)
+                poll_timeout_us = MAX_PENDING_INTERVAL_US
+                continue
+            if now < op["time"]:
+                # not yet time for this op; sleep-poll until then
+                poll_timeout_us = max(1, (op["time"] - now) // 1000)
+                continue
+            thread = process_to_thread(ctx, op["process"])
+            inboxes[thread].put(op)
+            ctx = ctx.with_time(op["time"]).busy(thread)
+            gen = gen_update(gen1, test, ctx, op)
+            if goes_in_history(op):
+                history.append(op)
+            outstanding += 1
+            poll_timeout_us = 0
+    except BaseException:
+        LOG.info("shutting down workers after abnormal exit")
+        for w in workers:
+            try:
+                w.inbox.put_nowait({"type": "exit"})
+            except queue.Full:
+                pass
+        raise

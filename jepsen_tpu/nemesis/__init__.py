@@ -1,0 +1,122 @@
+"""Nemesis protocol: fault injection driven by generator ops (reference
+`jepsen/src/jepsen/nemesis.clj:11-16`).
+
+A nemesis receives :info ops from the generator's nemesis thread and
+performs faults against the cluster. The full built-in nemesis stack
+(partitioners, grudges, clock skew, kill/pause) lives in sibling modules;
+this module holds the protocol, the noop nemesis, validation, and
+composition.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+class Nemesis:
+    def setup(self, test: dict) -> "Nemesis":
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        """Apply a fault op; returns the completion op."""
+        raise NotImplementedError
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+
+class Noop(Nemesis):
+    """Does nothing (`nemesis.clj:92-99`)."""
+
+    def invoke(self, test, op):
+        return dict(op)
+
+
+noop = Noop()
+
+
+class Validate(Nemesis):
+    """Asserts nemesis completions are well-formed (`nemesis.clj:49-90`)."""
+
+    def __init__(self, nemesis: Nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        res = self.nemesis.setup(test)
+        if not isinstance(res, Nemesis):
+            raise TypeError(f"nemesis setup returned non-nemesis {res!r}")
+        return Validate(res)
+
+    def invoke(self, test, op):
+        op2 = self.nemesis.invoke(test, op)
+        if not isinstance(op2, dict):
+            raise TypeError(
+                f"nemesis completion should be a dict, got {op2!r}")
+        return op2
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+
+def validate(n: Nemesis) -> Nemesis:
+    return Validate(n)
+
+
+class Compose(Nemesis):
+    """Routes ops to sub-nemeses by :f through per-nemesis f-sets or
+    f-mapping dicts (`nemesis.clj:384-428`)."""
+
+    def __init__(self, nemeses: dict):
+        """nemeses: {fs: nemesis} where fs is a frozenset of :f values, or
+        a dict mapping outer :f -> inner :f."""
+        self.nemeses = dict(nemeses)
+
+    def setup(self, test):
+        return Compose({fs: n.setup(test)
+                        for fs, n in self.nemeses.items()})
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        for fs, n in self.nemeses.items():
+            if isinstance(fs, dict):
+                if f in fs:
+                    inner = dict(op)
+                    inner["f"] = fs[f]
+                    out = n.invoke(test, inner)
+                    out = dict(out)
+                    out["f"] = f
+                    return out
+            elif f in fs:
+                return n.invoke(test, op)
+        raise ValueError(f"no nemesis handles f={f!r}")
+
+    def teardown(self, test):
+        for n in self.nemeses.values():
+            n.teardown(test)
+
+
+def compose(nemeses: dict) -> Nemesis:
+    return Compose(nemeses)
+
+
+class FnNemesis(Nemesis):
+    """Lift a function (test, op) -> op' into a nemesis."""
+
+    def __init__(self, f: Callable[[dict, dict], dict],
+                 setup_fn: Callable[[dict], None] | None = None,
+                 teardown_fn: Callable[[dict], None] | None = None):
+        self.f = f
+        self.setup_fn = setup_fn
+        self.teardown_fn = teardown_fn
+
+    def setup(self, test):
+        if self.setup_fn:
+            self.setup_fn(test)
+        return self
+
+    def invoke(self, test, op):
+        return self.f(test, op)
+
+    def teardown(self, test):
+        if self.teardown_fn:
+            self.teardown_fn(test)

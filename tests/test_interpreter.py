@@ -1,0 +1,206 @@
+"""Interpreter end-to-end tests: a complete run (generator -> workers ->
+history -> checker) in one process against the in-process atom register,
+mirroring the reference's `core_test.clj/basic-cas-test` (62-121) and
+worker-recovery tests (179-223)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu import models
+from jepsen_tpu import testkit
+from jepsen_tpu.checker.linear import analysis_host
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import History
+from jepsen_tpu.util import relative_time
+
+
+def cas_mix(r):
+    def g():
+        which = r.random()
+        if which < 0.4:
+            return {"f": "read"}
+        if which < 0.7:
+            return {"f": "write", "value": r.randrange(5)}
+        return {"f": "cas", "value": [r.randrange(5), r.randrange(5)]}
+    return g
+
+
+def run_test(test):
+    with relative_time():
+        return interpreter.run(test)
+
+
+def test_basic_cas_run_is_linearizable():
+    r = random.Random(45100)
+    state = testkit.AtomState(0)
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 5,
+        "client": testkit.atom_client(state, latency_s=0.0005),
+        "generator": gen.clients(gen.limit(300, cas_mix(r))),
+    })
+    hist = run_test(test)
+    invokes = [o for o in hist if o["type"] == "invoke"]
+    assert len(invokes) == 300
+    # every invoke has a completion
+    assert len(hist) == 600
+    # concurrency actually happened: some op overlaps another
+    a = analysis_host(models.cas_register(0), hist)
+    assert a["valid?"] is True
+
+
+def test_histories_are_time_ordered_and_indexed():
+    r = random.Random(7)
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 3,
+        "client": testkit.atom_client(testkit.AtomState(0)),
+        "generator": gen.clients(gen.limit(30, cas_mix(r))),
+    })
+    hist = run_test(test)
+    ts = [o["time"] for o in hist]
+    assert ts == sorted(ts)
+    procs = {o["process"] for o in hist}
+    assert procs <= {0, 1, 2}
+
+
+class CrashyClient(jclient.Client):
+    """Crashes every third invoke; tracks open/close balance."""
+
+    def __init__(self):
+        self.n = 0
+        self.opens = 0
+        self.closes = 0
+
+    def open(self, test, node):
+        self.opens += 1
+        return self
+
+    def close(self, test):
+        self.closes += 1
+
+    def invoke(self, test, op):
+        self.n += 1
+        if self.n % 3 == 0:
+            raise RuntimeError("kaboom")
+        out = dict(op)
+        out["type"] = "ok"
+        return out
+
+
+def test_worker_crash_becomes_info_and_process_retires():
+    test = testkit.noop_test()
+    client = CrashyClient()
+    test.update({
+        "concurrency": 2,
+        "client": client,
+        "generator": gen.clients(
+            gen.limit(12, gen.repeat({"f": "read"}))),
+    })
+    hist = run_test(test)
+    infos = [o for o in hist if o["type"] == "info"]
+    assert infos, "crashes must surface as info ops"
+    for o in infos:
+        assert o["error"].startswith("indeterminate")
+    # crashed processes are retired: fresh process ids appear
+    assert max(o["process"] for o in hist) >= 2
+    # a non-reusable client is closed+reopened for each fresh process
+    assert client.opens > 1
+    assert client.closes >= client.opens - 1
+
+
+class FailingOpen(jclient.Client):
+    def open(self, test, node):
+        raise RuntimeError("cannot connect")
+
+    def invoke(self, test, op):
+        raise AssertionError("unreachable")
+
+
+def test_failed_open_yields_fail_ops_not_hang():
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 2,
+        "client": FailingOpen(),
+        "generator": gen.clients(
+            gen.limit(4, gen.repeat({"f": "read"}))),
+    })
+    hist = run_test(test)
+    fails = [o for o in hist if o["type"] == "fail"]
+    assert len(fails) == 4
+    assert all(o["error"][0] == "no-client" for o in fails)
+
+
+def test_nemesis_ops_route_to_nemesis():
+    seen = []
+
+    def nem(test, op):
+        seen.append(op["f"])
+        out = dict(op)
+        out["value"] = "partitioned"
+        return out
+
+    from jepsen_tpu import nemesis as jnemesis
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 2,
+        "client": testkit.atom_client(testkit.AtomState(0)),
+        "nemesis": jnemesis.FnNemesis(nem),
+        "generator": gen.phases(
+            gen.nemesis(gen.once({"type": "info", "f": "start"})),
+            gen.clients(gen.limit(4, gen.repeat({"f": "read"}))),
+        ),
+    })
+    hist = run_test(test)
+    assert seen == ["start"]
+    nem_ops = [o for o in hist if o["process"] == "nemesis"]
+    assert len(nem_ops) == 2  # invoke + completion
+    assert nem_ops[-1]["value"] == "partitioned"
+
+
+def test_sleep_and_log_ops_stay_out_of_history():
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 1,
+        "client": testkit.atom_client(testkit.AtomState(0)),
+        "generator": gen.clients([
+            gen.once(gen.sleep(0.01)),
+            gen.once(gen.log("hello")),
+            gen.once({"f": "read"}),
+        ]),
+    })
+    hist = run_test(test)
+    assert all(o.get("type") not in ("sleep", "log") for o in hist)
+    assert [o["f"] for o in hist] == ["read", "read"]
+
+
+def test_generator_exception_shuts_down_workers():
+    def boom():
+        raise RuntimeError("generator exploded")
+
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 2,
+        "client": testkit.atom_client(testkit.AtomState(0)),
+        "generator": gen.clients([gen.once({"f": "read"}), boom]),
+    })
+    with pytest.raises(gen.GenException):
+        run_test(test)
+
+
+def test_time_limited_run_terminates():
+    r = random.Random(3)
+    test = testkit.noop_test()
+    test.update({
+        "concurrency": 3,
+        "client": testkit.atom_client(testkit.AtomState(0),
+                                      latency_s=0.0002),
+        "generator": gen.clients(
+            gen.time_limit(0.3, gen.stagger(0.001, cas_mix(r)))),
+    })
+    hist = run_test(test)
+    assert len(hist) > 10
+    assert History(hist).pair_index()  # well-formed pairs
