@@ -94,8 +94,12 @@ class ClientWorker(Worker):
 
 
 class NemesisWorker(Worker):
+    """Validates completions so a misbehaving nemesis crashes its own op
+    (becoming :info) instead of wedging the scheduler."""
+
     def invoke(self, test, op):
-        return test["nemesis"].invoke(test, op)
+        from .. import nemesis as jnemesis
+        return jnemesis.Validate(test["nemesis"]).invoke(test, op)
 
 
 class ClientNemesisWorker(Worker):
@@ -232,8 +236,16 @@ def run(test: dict) -> History:
     except BaseException:
         LOG.info("shutting down workers after abnormal exit")
         for w in workers:
+            # the 1-slot inbox may still hold an undelivered op; displace
+            # it so the exit sentinel always lands
+            try:
+                w.inbox.get_nowait()
+            except queue.Empty:
+                pass
             try:
                 w.inbox.put_nowait({"type": "exit"})
             except queue.Full:
                 pass
+        for w in workers:
+            w.thread.join(timeout=5)
         raise

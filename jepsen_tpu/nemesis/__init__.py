@@ -35,6 +35,14 @@ class Noop(Nemesis):
 noop = Noop()
 
 
+class InvalidCompletion(Exception):
+    def __init__(self, op, op2, problems):
+        self.op, self.op2, self.problems = op, op2, problems
+        super().__init__(
+            "nemesis returned an invalid completion: "
+            + "; ".join(problems) + f" — invoke {op!r}, completion {op2!r}")
+
+
 class Validate(Nemesis):
     """Asserts nemesis completions are well-formed (`nemesis.clj:49-90`)."""
 
@@ -49,9 +57,16 @@ class Validate(Nemesis):
 
     def invoke(self, test, op):
         op2 = self.nemesis.invoke(test, op)
+        problems = []
         if not isinstance(op2, dict):
-            raise TypeError(
-                f"nemesis completion should be a dict, got {op2!r}")
+            problems.append("should be a dict")
+        else:
+            if op2.get("process") != op.get("process"):
+                problems.append(":process should be the same")
+            if op2.get("f") != op.get("f"):
+                problems.append(":f should be the same")
+        if problems:
+            raise InvalidCompletion(op, op2, problems)
         return op2
 
     def teardown(self, test):
@@ -66,18 +81,21 @@ class Compose(Nemesis):
     """Routes ops to sub-nemeses by :f through per-nemesis f-sets or
     f-mapping dicts (`nemesis.clj:384-428`)."""
 
-    def __init__(self, nemeses: dict):
-        """nemeses: {fs: nemesis} where fs is a frozenset of :f values, or
-        a dict mapping outer :f -> inner :f."""
-        self.nemeses = dict(nemeses)
+    def __init__(self, nemeses):
+        """nemeses: pairs of (fs, nemesis) where fs is either a set of :f
+        values this nemesis handles, or a dict mapping outer :f -> inner
+        :f (the op is rewritten on the way in and back on the way out).
+        Accepts a dict {frozenset: nemesis} or, since dicts can't be dict
+        keys, a list of (fs_or_fmap, nemesis) pairs."""
+        pairs = nemeses.items() if isinstance(nemeses, dict) else nemeses
+        self.nemeses = tuple((fs, n) for fs, n in pairs)
 
     def setup(self, test):
-        return Compose({fs: n.setup(test)
-                        for fs, n in self.nemeses.items()})
+        return Compose([(fs, n.setup(test)) for fs, n in self.nemeses])
 
     def invoke(self, test, op):
         f = op.get("f")
-        for fs, n in self.nemeses.items():
+        for fs, n in self.nemeses:
             if isinstance(fs, dict):
                 if f in fs:
                     inner = dict(op)
@@ -91,11 +109,11 @@ class Compose(Nemesis):
         raise ValueError(f"no nemesis handles f={f!r}")
 
     def teardown(self, test):
-        for n in self.nemeses.values():
+        for _, n in self.nemeses:
             n.teardown(test)
 
 
-def compose(nemeses: dict) -> Nemesis:
+def compose(nemeses) -> Nemesis:
     return Compose(nemeses)
 
 
