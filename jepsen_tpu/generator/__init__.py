@@ -611,42 +611,55 @@ def each_thread(gen):
 @dataclasses.dataclass(frozen=True)
 class Reserve(Gen):
     """Dedicated thread ranges per generator, remainder to a default
-    (`generator.clj:1056`)."""
-    ranges: tuple     # tuple of frozensets of threads
-    gens: tuple       # len(ranges)+1; last is the default
+    (`generator.clj:1056`). Ranges are *positional* within the current
+    context's ordered thread list (integer threads in order, then the
+    nemesis), so reserve composes with thread-restricting wrappers like
+    on_threads and independent's concurrent groups."""
+    counts: tuple     # threads per reserved range
+    gens: tuple       # len(counts)+1; last is the default
+
+    @staticmethod
+    def _ordered_threads(ctx: Context) -> builtins.list:
+        ints = sorted(t for t in ctx.workers if isinstance(t, int))
+        rest = [t for t in ctx.workers if not isinstance(t, int)]
+        return ints + rest
+
+    def _range_sets(self, ctx: Context) -> builtins.list:
+        """Per-range thread sets for this context, plus the remainder."""
+        ordered = self._ordered_threads(ctx)
+        sets = []
+        n = 0
+        for count in self.counts:
+            sets.append(frozenset(ordered[n:n + count]))
+            n += count
+        sets.append(frozenset(ordered[n:]))
+        return sets
 
     def op(self, test, ctx):
         best = None
-        claimed = frozenset().union(*self.ranges) if self.ranges \
-            else frozenset()
-        for i, threads in enumerate(self.ranges):
+        for i, threads in enumerate(self._range_sets(ctx)):
             sub = _restrict_ctx(lambda t, s=threads: t in s, ctx)
             res = op(self.gens[i], test, sub)
             if res is not None:
                 best = _soonest(best, {"op": res[0], "gen": res[1],
                                        "i": i, "weight": len(threads)})
-        sub = _restrict_ctx(lambda t: t not in claimed, ctx)
-        res = op(self.gens[-1], test, sub)
-        if res is not None:
-            best = _soonest(best, {"op": res[0], "gen": res[1],
-                                   "i": len(self.ranges),
-                                   "weight": len(sub.workers)})
         if best is None:
             return None
         gens = builtins.list(self.gens)
         gens[best["i"]] = best["gen"]
-        return best["op"], Reserve(self.ranges, tuple(gens))
+        return best["op"], Reserve(self.counts, tuple(gens))
 
     def update(self, test, ctx, event):
         thread = process_to_thread(ctx, event.get("process"))
-        i = len(self.ranges)
-        for j, threads in enumerate(self.ranges):
+        sets = self._range_sets(ctx)
+        i = len(self.counts)
+        for j, threads in enumerate(sets[:-1]):
             if thread in threads:
                 i = j
                 break
         gens = builtins.list(self.gens)
         gens[i] = update(gens[i], test, ctx, event)
-        return Reserve(self.ranges, tuple(gens))
+        return Reserve(self.counts, tuple(gens))
 
 
 def reserve(*args):
@@ -654,13 +667,11 @@ def reserve(*args):
     write_gen, next 10 cas_gen, the rest read_gen."""
     assert len(args) % 2 == 1, "reserve needs a trailing default generator"
     *pairs, default = args
-    ranges, gens = [], []
-    n = 0
+    counts, gens = [], []
     for count, gen in zip(pairs[0::2], pairs[1::2]):
-        ranges.append(frozenset(range(n, n + count)))
+        counts.append(count)
         gens.append(gen)
-        n += count
-    return Reserve(tuple(ranges), tuple(gens) + (default,))
+    return Reserve(tuple(counts), tuple(gens) + (default,))
 
 
 # ---------------------------------------------------------------------------

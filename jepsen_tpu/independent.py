@@ -205,46 +205,45 @@ class ConcurrentGenerator(Gen):
         best = None
         exhausted = 0
         for gid in gids:
-            st = me._group_state(gid)
-            if st is None:
-                k = me.keys.get(me.cursor)
-                if k is None:
-                    exhausted += 1
-                    continue
-                st = (me.cursor, tuple_gen(k, me.fgen(k)))
-                me = me._with_group(gid, st[0], st[1],
-                                    cursor=me.cursor + 1)
-            ki, g = st
             sub = gen.Context(
                 ctx.time,
                 tuple(t for t in ctx.free_threads
                       if me._group_pred(gid)(t)),
                 {t: p for t, p in ctx.workers.items()
                  if me._group_pred(gid)(t)})
-            res = gen.op(g, test, sub)
-            if res is None:
-                # this key is done; group claims the next key
-                me = me._with_group(gid, None, None)
-                k = me.keys.get(me.cursor)
-                if k is None:
-                    exhausted += 1
+            # Claim keys until this group has a generator that yields —
+            # empty per-key generators must not end the group while the
+            # key stream has more keys.
+            res = None
+            ki = None
+            while True:
+                st = me._group_state(gid)
+                if st is None:
+                    k = me.keys.get(me.cursor)
+                    if k is None:
+                        exhausted += 1
+                        break
+                    me = me._with_group(gid, me.cursor,
+                                        tuple_gen(k, me.fgen(k)),
+                                        cursor=me.cursor + 1)
                     continue
-                me = me._with_group(gid, me.cursor,
-                                    tuple_gen(k, me.fgen(k)),
-                                    cursor=me.cursor + 1)
-                ki, g = me._group_state(gid)
+                ki, g = st
                 res = gen.op(g, test, sub)
                 if res is None:
-                    exhausted += 1
+                    me = me._with_group(gid, None, None)  # key done
                     continue
+                break
+            if res is None:
+                continue
             o, g1 = res
             cand = {"op": o, "gen": me._with_group(gid, ki, g1,
                                                    cursor=me.cursor),
                     "weight": self.n}
             best = gen._soonest(best, cand)
         if best is not None:
-            # merge realized-group/cursor state: each candidate's generator
-            # already carries `me`'s shared cursor via _with_group above
+            # each candidate's generator snapshot carries the shared
+            # cursor/groups state as of its build; losing candidates'
+            # claims are deterministically redone on the next call
             return best["op"], best["gen"]
         if exhausted == len(gids):
             return None

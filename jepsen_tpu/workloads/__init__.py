@@ -1,0 +1,14 @@
+"""Workload bundles: generator + checker (+ model) packages for standard
+consistency tests, mirroring the reference's `jepsen/src/jepsen/tests/`
+namespace family.
+
+Each module exposes a `workload(...)`/`test(...)` builder returning a dict
+with at least 'generator' and 'checker' entries, merged into a test map by
+suites (pattern: `zookeeper.clj:106-129`).
+"""
+
+from . import adya, bank, causal, causal_reverse, linearizable_register, \
+    long_fork  # noqa: F401
+
+__all__ = ["adya", "bank", "causal", "causal_reverse",
+           "linearizable_register", "long_fork"]

@@ -135,3 +135,16 @@ def test_independent_checker_tpu_batched():
     assert res["results"]["b"]["valid?"] is False
     # the batched path actually ran on device
     assert "tpu" in res["results"]["a"]["analyzer"]
+
+
+def test_concurrent_generator_skips_empty_key_generators():
+    # keys 0-1 yield empty generators; productive keys must still run
+    def fgen(k):
+        if k < 2:
+            return None
+        return gen.limit(2, gen.repeat({"f": "w", "value": k}))
+
+    g = concurrent_generator(2, iter(range(4)), fgen)
+    ops = quick(n_plus_nemesis_context(2), gen.clients(g))
+    assert [o["value"] for o in ops] == [
+        KV(2, 2), KV(2, 2), KV(3, 3), KV(3, 3)]

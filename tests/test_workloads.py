@@ -1,0 +1,337 @@
+"""Workload generator/checker tests on literal histories, mirroring the
+reference's tests/*_test.clj suites."""
+
+import jepsen_tpu.generator as gen
+from jepsen_tpu.generator import simulate as sim
+from jepsen_tpu.history import history
+from jepsen_tpu.independent import KV
+from jepsen_tpu.workloads import (adya, bank, causal, causal_reverse,
+                                  linearizable_register, long_fork)
+
+
+# -- bank -------------------------------------------------------------------
+
+BANK_TEST = {"accounts": [0, 1], "total-amount": 10, "max-transfer": 3}
+
+
+def _read(process, balances, t=0):
+    return [{"type": "invoke", "f": "read", "value": None,
+             "process": process, "time": t},
+            {"type": "ok", "f": "read", "value": balances,
+             "process": process, "time": t + 1}]
+
+
+def test_bank_valid():
+    h = history(_read(0, {0: 4, 1: 6}) + _read(1, {0: 10, 1: 0}))
+    res = bank.checker().check(BANK_TEST, h, {})
+    assert res["valid?"] is True
+    assert res["read-count"] == 2
+
+
+def test_bank_wrong_total():
+    h = history(_read(0, {0: 4, 1: 7}))
+    res = bank.checker().check(BANK_TEST, h, {})
+    assert res["valid?"] is False
+    assert res["errors"]["wrong-total"]["count"] == 1
+    assert res["errors"]["wrong-total"]["first"]["total"] == 11
+
+
+def test_bank_negative_balance():
+    h = history(_read(0, {0: 12, 1: -2}))
+    res = bank.checker().check(BANK_TEST, h, {})
+    assert res["valid?"] is False
+    assert "negative-value" in res["errors"]
+    # allowed when negative-balances? is set
+    res2 = bank.checker({"negative-balances?": True}).check(
+        BANK_TEST, h, {})
+    assert res2["valid?"] is True
+
+
+def test_bank_nil_balance_and_unexpected_key():
+    res = bank.checker().check(
+        BANK_TEST, history(_read(0, {0: None, 1: 10})), {})
+    assert res["valid?"] is False and "nil-balance" in res["errors"]
+    res = bank.checker().check(
+        BANK_TEST, history(_read(0, {7: 10})), {})
+    assert res["valid?"] is False and "unexpected-key" in res["errors"]
+
+
+def test_bank_generator_shape():
+    t = {**BANK_TEST, "accounts": [0, 1, 2]}
+    with gen.fixed_rng(1):
+        ops = sim.quick(sim.n_plus_nemesis_context(2),
+                        gen.clients(gen.limit(50, bank.generator())))
+    assert len(ops) == 50
+    for o in ops:
+        if o["f"] == "transfer":
+            v = o["value"]
+            assert v["from"] != v["to"]
+            assert 1 <= v["amount"] <= 5
+
+
+# -- long fork --------------------------------------------------------------
+
+def _lf_read(process, kvs, t):
+    txn = [["r", k, v] for k, v in kvs]
+    return [{"type": "invoke", "f": "read",
+             "value": [["r", k, None] for k, _ in kvs],
+             "process": process, "time": t},
+            {"type": "ok", "f": "read", "value": txn,
+             "process": process, "time": t + 1}]
+
+
+def _lf_write(process, k, t):
+    txn = [["w", k, 1]]
+    return [{"type": "invoke", "f": "write", "value": txn,
+             "process": process, "time": t},
+            {"type": "ok", "f": "write", "value": txn,
+             "process": process, "time": t + 1}]
+
+
+def test_long_fork_detects_fork():
+    h = history(
+        _lf_write(0, 0, 0) + _lf_write(1, 1, 2)
+        + _lf_read(2, [(0, 1), (1, None)], 4)     # sees x, not y
+        + _lf_read(3, [(0, None), (1, 1)], 6))    # sees y, not x
+    res = long_fork.checker(2).check({}, h, {})
+    assert res["valid?"] is False
+    assert len(res["forks"]) == 1
+
+
+def test_long_fork_valid_history():
+    h = history(
+        _lf_write(0, 0, 0) + _lf_write(1, 1, 2)
+        + _lf_read(2, [(0, 1), (1, None)], 4)
+        + _lf_read(3, [(0, 1), (1, 1)], 6))
+    res = long_fork.checker(2).check({}, h, {})
+    assert res["valid?"] is True
+    assert res["reads-count"] == 2
+
+
+def test_long_fork_multiple_writes_unknown():
+    h = history(_lf_write(0, 0, 0) + _lf_write(1, 0, 2))
+    res = long_fork.checker(2).check({}, h, {})
+    assert res["valid?"] == "unknown"
+    assert res["error"][0] == "multiple-writes"
+
+
+def test_long_fork_group_math():
+    assert long_fork.group_for(2, 5) == [4, 5]
+    assert long_fork.group_for(3, 3) == [3, 4, 5]
+    with gen.fixed_rng(7):
+        txn = long_fork.read_txn_for(2, 4)
+    assert sorted(m[1] for m in txn) == [4, 5]
+
+
+def test_long_fork_generator():
+    with gen.fixed_rng(3):
+        ops = sim.quick(sim.n_plus_nemesis_context(3),
+                        gen.clients(gen.limit(30, long_fork.generator(2))))
+    assert len(ops) == 30
+    writes = [o for o in ops if o["f"] == "write"]
+    reads = [o for o in ops if o["f"] == "read"]
+    assert writes and reads
+    # writes hit fresh keys
+    written = [o["value"][0][1] for o in writes]
+    assert len(set(written)) == len(written)
+    # reads cover whole groups
+    for o in reads:
+        ks = {m[1] for m in o["value"]}
+        assert len(ks) == 2
+
+
+# -- causal -----------------------------------------------------------------
+
+def _c_op(process, f, v, pos, link, t):
+    return [{"type": "invoke", "f": f, "value": None if f != "write" else v,
+             "process": process, "time": t,
+             "position": pos, "link": link},
+            {"type": "ok", "f": f, "value": v, "process": process,
+             "time": t + 1, "position": pos, "link": link}]
+
+
+def test_causal_valid_chain():
+    h = history(
+        _c_op(0, "read-init", 0, 10, "init", 0)
+        + _c_op(0, "write", 1, 11, 10, 2)
+        + _c_op(0, "read", 1, 12, 11, 4)
+        + _c_op(0, "write", 2, 13, 12, 6)
+        + _c_op(0, "read", 2, 14, 13, 8))
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is True
+
+
+def test_causal_broken_link():
+    h = history(
+        _c_op(0, "read-init", 0, 10, "init", 0)
+        + _c_op(0, "write", 1, 11, 99, 2))  # links to unseen position
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is False
+    assert "Cannot link" in res["error"]
+
+
+def test_causal_stale_read():
+    h = history(
+        _c_op(0, "read-init", 0, 10, "init", 0)
+        + _c_op(0, "write", 1, 11, 10, 2)
+        + _c_op(0, "read", 0, 12, 11, 4))  # reads old value 0 after w1
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is False
+    assert "can't read" in res["error"]
+
+
+def test_causal_write_out_of_order():
+    h = history(_c_op(0, "write", 2, 10, "init", 0))  # expected 1
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is False
+
+
+# -- causal reverse ---------------------------------------------------------
+
+def test_causal_reverse_detects_missing_predecessor():
+    # w1 acked before w2 invoked; a read sees 2 but not 1
+    h = history([
+        {"type": "invoke", "f": "write", "value": 1, "process": 0,
+         "time": 0},
+        {"type": "ok", "f": "write", "value": 1, "process": 0, "time": 1},
+        {"type": "invoke", "f": "write", "value": 2, "process": 1,
+         "time": 2},
+        {"type": "ok", "f": "write", "value": 2, "process": 1, "time": 3},
+        {"type": "invoke", "f": "read", "value": None, "process": 2,
+         "time": 4},
+        {"type": "ok", "f": "read", "value": [2], "process": 2, "time": 5},
+    ])
+    res = causal_reverse.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["missing"] == [1]
+
+
+def test_causal_reverse_concurrent_writes_ok():
+    # w1 and w2 concurrent: seeing either alone is fine
+    h = history([
+        {"type": "invoke", "f": "write", "value": 1, "process": 0,
+         "time": 0},
+        {"type": "invoke", "f": "write", "value": 2, "process": 1,
+         "time": 1},
+        {"type": "ok", "f": "write", "value": 1, "process": 0, "time": 2},
+        {"type": "ok", "f": "write", "value": 2, "process": 1, "time": 3},
+        {"type": "invoke", "f": "read", "value": None, "process": 2,
+         "time": 4},
+        {"type": "ok", "f": "read", "value": [2], "process": 2, "time": 5},
+    ])
+    res = causal_reverse.checker().check({}, h, {})
+    assert res["valid?"] is True
+
+
+# -- adya g2 ----------------------------------------------------------------
+
+def test_adya_g2_checker():
+    def ins(process, k, ab, typ, t):
+        return [{"type": "invoke", "f": "insert", "value": KV(k, ab),
+                 "process": process, "time": t},
+                {"type": typ, "f": "insert", "value": KV(k, ab),
+                 "process": process, "time": t + 1}]
+
+    # key 0: both inserts succeed (G2!) — key 1: only one does
+    h = history(ins(0, 0, [1, None], "ok", 0)
+                + ins(1, 0, [None, 2], "ok", 2)
+                + ins(2, 1, [3, None], "ok", 4)
+                + ins(3, 1, [None, 4], "fail", 6))
+    res = adya.g2_checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["illegal"] == {0: 2}
+    assert res["key-count"] == 2
+    assert res["legal-count"] == 1
+
+    h2 = history(ins(2, 1, [3, None], "ok", 0)
+                 + ins(3, 1, [None, 4], "fail", 2))
+    res2 = adya.g2_checker().check({}, h2, {})
+    assert res2["valid?"] is True
+
+
+def test_adya_generator_two_inserts_per_key():
+    g = adya.g2_gen()
+    ops = sim.quick(sim.n_plus_nemesis_context(4),
+                    gen.clients(gen.limit(8, g)))
+    by_key = {}
+    for o in ops:
+        assert o["f"] == "insert"
+        by_key.setdefault(o["value"].key, []).append(o["value"].value)
+    for k, vals in by_key.items():
+        assert len(vals) <= 2
+        ids = [x for pair in vals for x in pair if x is not None]
+        assert len(ids) == len(set(ids))  # globally unique ids
+
+
+# -- linearizable register --------------------------------------------------
+
+def test_linearizable_register_bundle():
+    t = linearizable_register.test({"nodes": ["a", "b"],
+                                   "per-key-limit": 10})
+    with gen.fixed_rng(5):
+        ops = sim.quick(sim.n_plus_nemesis_context(8),
+                        gen.clients(gen.limit(40, t["generator"])))
+    assert len(ops) == 40
+    assert {o["f"] for o in ops} <= {"read", "write", "cas"}
+    # end-to-end check of a tiny valid keyed history
+    h = history([
+        {"type": "invoke", "f": "write", "value": KV(0, 3), "process": 0,
+         "time": 0},
+        {"type": "ok", "f": "write", "value": KV(0, 3), "process": 0,
+         "time": 1},
+        {"type": "invoke", "f": "read", "value": KV(0, None), "process": 1,
+         "time": 2},
+        {"type": "ok", "f": "read", "value": KV(0, 3), "process": 1,
+         "time": 3},
+    ])
+    res = t["checker"].check({}, h, {})
+    assert res["valid?"] is True
+
+
+def test_causal_test_bundle_builds():
+    t = causal.test({"time-limit": 1})
+    assert t["generator"] is not None and t["checker"] is not None
+
+
+def test_causal_reverse_workload_builds():
+    w = causal_reverse.workload({"nodes": ["a", "b"], "per-key-limit": 5})
+    with gen.fixed_rng(2):
+        ops = sim.quick(sim.n_plus_nemesis_context(2),
+                        gen.clients(gen.limit(10, w["generator"])))
+    assert len(ops) == 10
+
+
+def test_bank_test_bundle_builds():
+    t = bank.test()
+    assert t["accounts"] == list(range(8))
+    assert t["generator"] is not None
+
+
+def test_linearizable_register_reads_in_every_group():
+    # reserve must be positional within each key group's thread range:
+    # every key's history needs read coverage, not just group 0's
+    t = linearizable_register.test({"nodes": ["a"], "per-key-limit": 12})
+    with gen.fixed_rng(13):
+        ops = sim.quick(sim.n_plus_nemesis_context(4),
+                        gen.clients(gen.limit(48, t["generator"])))
+    by_key = {}
+    for o in ops:
+        by_key.setdefault(o["value"].key, []).append(o["f"])
+    assert len(by_key) >= 2
+    for k, fs in by_key.items():
+        assert "read" in fs, f"key {k} got no reads: {fs}"
+
+
+def test_linearizable_register_tiny_per_key_limit():
+    t = linearizable_register.test({"nodes": ["a"], "per-key-limit": 1})
+    with gen.fixed_rng(1):
+        ops = sim.quick(sim.n_plus_nemesis_context(2),
+                        gen.clients(gen.limit(6, t["generator"])))
+    assert len(ops) == 6  # limit 1 per key, never 0
+
+
+def test_bank_test_merges_opts():
+    t = bank.test({"accounts": [0, 1], "total-amount": 10})
+    assert t["accounts"] == [0, 1]
+    assert t["total-amount"] == 10
+    assert t["max-transfer"] == 5  # default retained
