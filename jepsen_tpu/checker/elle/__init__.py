@@ -1,0 +1,134 @@
+"""Elle-class transactional anomaly detection, TPU-native.
+
+The reference's per-suite `append`/`wr` workloads call the Elle JVM
+library (`jepsen/src/jepsen/tests/cycle{,/append,/wr}.clj`). Here the
+dependency graphs are built host-side (numpy) and every cycle question is
+answered on device (`kernels.py`): transitive closure as repeated boolean
+matrix squaring on the MXU, with optional mesh sharding for huge
+histories.
+
+Anomaly specs accept Adya shorthand: 'G1' expands to G1a+G1b+G1c, 'G2'
+to G-single+G2-item (matching `tests/cycle/wr.clj:31-45`'s taxonomy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable
+
+from .. import Checker
+from . import kernels, list_append, wr  # noqa: F401
+
+_EXPANSIONS = {
+    "G1": ("G1a", "G1b", "G1c"),
+    "G2": ("G-single", "G2-item"),
+}
+
+
+def expand_anomalies(anomalies: Iterable[str]) -> tuple:
+    out: list = []
+    for a in anomalies:
+        for x in _EXPANSIONS.get(a, (a,)):
+            if x not in out:
+                out.append(x)
+    return tuple(out)
+
+
+class ListAppendChecker(Checker):
+    """Checker adapter over list_append.check (reference
+    `tests/cycle/append.clj:11-55`; default anomalies [:G1 :G2] plus the
+    definite single-pass errors)."""
+
+    def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None):
+        extra = ("internal", "duplicate-elements", "incompatible-order")
+        self.anomalies = expand_anomalies(tuple(anomalies) + extra)
+        self.mesh = mesh
+
+    def check(self, test, hist, opts):
+        return list_append.check(hist, self.anomalies, mesh=self.mesh)
+
+
+class RWRegisterChecker(Checker):
+    """Checker adapter over wr.check (reference
+    `tests/cycle/wr.clj:14-54`)."""
+
+    def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None):
+        extra = ("internal", "duplicate-writes")
+        self.anomalies = expand_anomalies(tuple(anomalies) + extra)
+        self.mesh = mesh
+
+    def check(self, test, hist, opts):
+        return wr.check(hist, self.anomalies, mesh=self.mesh)
+
+
+def list_append_checker(anomalies=("G0", "G1", "G2"), mesh=None) -> Checker:
+    return ListAppendChecker(anomalies, mesh)
+
+
+def rw_register_checker(anomalies=("G0", "G1", "G2"), mesh=None) -> Checker:
+    return RWRegisterChecker(anomalies, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Generators (reference: elle.list-append/gen, elle.rw-register/gen, used
+# by tests/cycle/append.clj:19-27 and tests/cycle/wr.clj:12,51)
+# ---------------------------------------------------------------------------
+
+from ... import generator as gen  # noqa: E402
+
+
+@dataclasses.dataclass(frozen=True)
+class _TxnGen(gen.Gen):
+    """Random transactions over a sliding window of active keys. Appends/
+    writes use per-key monotone counters so every written value is unique
+    and (for appends) traceable."""
+    mode: str               # 'append' | 'wr'
+    key_count: int          # active window size
+    min_len: int
+    max_len: int
+    max_writes_per_key: int
+    next_key: int           # keys [next_key - key_count, next_key) active
+    counters: tuple         # ((key, next value), ...)
+
+    def op(self, test, ctx):
+        length = gen.rng.randint(self.min_len, self.max_len)
+        txn = []
+        counters = dict(self.counters)
+        next_key = self.next_key
+        lo = max(0, next_key - self.key_count)
+        write_f = "append" if self.mode == "append" else "w"
+        for _ in range(length):
+            k = gen.rng.randrange(lo, max(lo + 1, next_key))
+            if gen.rng.random() < 0.5:
+                v = counters.get(k, 1)
+                counters[k] = v + 1
+                txn.append([write_f, k, v])
+                if v >= self.max_writes_per_key:
+                    next_key += 1  # retire the hottest key, open a new one
+            else:
+                txn.append(["r", k, None])
+        o = gen.fill_in_op({"f": "txn", "value": txn}, ctx)
+        if o is gen.PENDING:
+            return gen.PENDING, self
+        return o, dataclasses.replace(
+            self, next_key=next_key,
+            counters=tuple(sorted(counters.items())))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def append_gen(key_count: int = 5, min_txn_length: int = 1,
+               max_txn_length: int = 4,
+               max_writes_per_key: int = 16) -> gen.Gen:
+    """List-append transaction generator."""
+    return _TxnGen("append", key_count, min_txn_length, max_txn_length,
+                   max_writes_per_key, 1, ())
+
+
+def wr_gen(key_count: int = 5, min_txn_length: int = 1,
+           max_txn_length: int = 4,
+           max_writes_per_key: int = 16) -> gen.Gen:
+    """Write/read register transaction generator."""
+    return _TxnGen("wr", key_count, min_txn_length, max_txn_length,
+                   max_writes_per_key, 1, ())

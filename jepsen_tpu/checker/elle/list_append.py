@@ -1,0 +1,251 @@
+"""Elle-class list-append checker (reference consumes
+`elle.list-append/check` via `jepsen/src/jepsen/tests/cycle/append.clj:
+11-55`; algorithm re-derived from the Elle paper's list-append analysis).
+
+Txns are micro-op lists mixing ['append', k, v] and ['r', k, [v...]].
+Because appends are traceable — every read of k returns the *full
+append order so far* — the per-key version order is recoverable:
+
+  * a read whose value is None carries no information (the client never
+    filled it in); an observed-empty read is [];
+  * every observed read list must be a prefix of the longest one
+    (else 'incompatible-order');
+  * the longest list per key is the version chain v1 < v2 < ...;
+  * ww: writer(vi) -> writer(vi+1) for consecutive versions with
+    distinct writers;
+  * wr: writer(last element of a read) -> reader;
+  * rw: reader of a prefix ending at vi -> writer(vi+1) (reads of the
+    empty list anti-depend on the first writer).
+
+Single-pass anomalies: duplicate appended elements, G1a (reading a
+failed txn's append), G1b (observing an intermediate state of a
+multi-append txn), internal (a txn's read inconsistent with its own
+earlier ops).
+
+Cycle anomalies (G0/G1c/G-single/G2-item) are decided on device by
+`kernels.analyze_graph`; certificates are reconstructed host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ... import txn as mop
+from ...history import history as as_history, is_fail, is_info, is_ok
+from . import kernels
+
+
+def _is_append(m) -> bool:
+    return m[0] == "append"
+
+
+def _is_read(m) -> bool:
+    return m[0] == "r"
+
+
+def op_internal_case(op: dict) -> dict | None:
+    """A txn's reads must be consistent with its own earlier appends: a
+    read of k after this txn appended vs must end with those vs in
+    order."""
+    expected_suffix: dict[Any, list] = {}
+    prev_read: dict[Any, list] = {}
+    for m in op.get("value") or ():
+        k = mop.key(m)
+        if _is_append(m):
+            expected_suffix.setdefault(k, []).append(mop.value(m))
+            if k in prev_read:
+                prev_read[k] = prev_read[k] + [mop.value(m)]
+        elif _is_read(m):
+            if mop.value(m) is None:
+                continue  # unfilled read: no information
+            v = list(mop.value(m))
+            suffix = expected_suffix.get(k, [])
+            if suffix and v[len(v) - len(suffix):] != suffix:
+                return {"op": op, "mop": list(m),
+                        "expected": ["...", *suffix]}
+            if k in prev_read and v[:len(prev_read[k])] != prev_read[k]:
+                return {"op": op, "mop": list(m),
+                        "expected": prev_read[k]}
+            prev_read[k] = v
+    return None
+
+
+def internal_cases(hist) -> list:
+    return [c for o in hist if is_ok(o)
+            for c in [op_internal_case(o)] if c is not None]
+
+
+class _Analysis:
+    """Shared single-pass extraction over an indexed client history."""
+
+    def __init__(self, hist):
+        hist = as_history(hist).index().client_ops()
+        self.hist = hist
+        self.oks = [o for o in hist if is_ok(o)]
+        self.infos = [o for o in hist if is_info(o)]
+        self.fails = [o for o in hist if is_fail(o)]
+        # writer_of[k][v] -> (op, final?) for ok/info appends
+        self.writer_of: dict[Any, dict[Any, tuple]] = {}
+        self.duplicates: list = []
+        for o in self.oks + self.infos:
+            appended: dict[Any, list] = {}
+            val = o.get("value")
+            if is_info(o) and not isinstance(val, (list, tuple)):
+                continue  # crashed before we knew the txn
+            for m in val or ():
+                if _is_append(m):
+                    appended.setdefault(mop.key(m), []).append(mop.value(m))
+            for k, vs in appended.items():
+                for i, v in enumerate(vs):
+                    w = self.writer_of.setdefault(k, {})
+                    if v in w:
+                        self.duplicates.append(
+                            {"key": k, "value": v,
+                             "ops": [w[v][0], o]})
+                    w[v] = (o, i == len(vs) - 1)
+        self.failed_writes = {
+            (mop.key(m), mop.value(m)): o
+            for o in self.fails
+            for m in (o.get("value") or ())
+            if _is_append(m)}
+
+    def version_orders(self):
+        """Longest observed prefix per key; returns (orders, incompatible)
+        where orders[k] is the version chain and incompatible lists
+        prefix-violations."""
+        longest: dict[Any, list] = {}
+        incompatible: list = []
+        for o in self.oks:
+            for m in o.get("value") or ():
+                if not _is_read(m) or mop.value(m) is None:
+                    continue
+                k, v = mop.key(m), list(mop.value(m))
+                cur = longest.get(k, [])
+                shorter, lnger = (v, cur) if len(v) <= len(cur) \
+                    else (cur, v)
+                if lnger[:len(shorter)] != shorter:
+                    incompatible.append(
+                        {"key": k, "values": [cur, v], "op": o})
+                elif len(v) > len(cur):
+                    longest[k] = v
+        return longest, incompatible
+
+    def g1a_cases(self) -> list:
+        """Reads observing a failed append (`aborted read`)."""
+        cases = []
+        for o in self.oks:
+            for m in o.get("value") or ():
+                if _is_read(m):
+                    for v in mop.value(m) or ():
+                        w = self.failed_writes.get((mop.key(m), v))
+                        if w is not None:
+                            cases.append({"op": o, "mop": list(m),
+                                          "writer": w})
+        return cases
+
+    def g1b_cases(self) -> list:
+        """Reads whose final observed element is a non-final append of a
+        multi-append txn (`intermediate read`)."""
+        cases = []
+        for o in self.oks:
+            for m in o.get("value") or ():
+                if _is_read(m) and mop.value(m):
+                    k, v = mop.key(m), mop.value(m)[-1]
+                    w = self.writer_of.get(k, {}).get(v)
+                    if w is not None and not w[1] and id(w[0]) != id(o):
+                        cases.append({"op": o, "mop": list(m),
+                                      "writer": w[0]})
+        return cases
+
+
+def graph(hist):
+    """Build the dependency graph. Returns (txn_ops, ww, wr, rw, edges)
+    where txn_ops[i] is the i-th transaction (ok/info), the matrices are
+    n x n numpy bools, and edges maps (i, j) -> set of edge-type
+    strings for host-side explanation."""
+    a = _Analysis(hist)
+    txns = a.oks + a.infos
+    idx = {id(o): i for i, o in enumerate(txns)}
+    n = len(txns)
+    ww = np.zeros((n, n), bool)
+    wr = np.zeros((n, n), bool)
+    rw = np.zeros((n, n), bool)
+    edges: dict[tuple, set] = {}
+
+    def add(mat, i, j, typ):
+        if i == j:
+            return
+        mat[i, j] = True
+        edges.setdefault((i, j), set()).add(typ)
+
+    orders, incompatible = a.version_orders()
+    # ww along each key's observed version chain
+    for k, chain in orders.items():
+        writers = a.writer_of.get(k, {})
+        for v1, v2 in zip(chain, chain[1:]):
+            w1, w2 = writers.get(v1), writers.get(v2)
+            if w1 and w2:
+                add(ww, idx[id(w1[0])], idx[id(w2[0])], "ww")
+    # wr + rw per read. A read returns the full prefix at its snapshot,
+    # so *any* append absent from it is a later version: the reader
+    # anti-depends on its writer (an rw;ww* composite — still exactly one
+    # anti-dependency, so classification is unaffected). Restricted to
+    # :ok writers: a crashed, never-observed append may not have executed.
+    for o in a.oks:
+        for m in o.get("value") or ():
+            if not _is_read(m) or mop.value(m) is None:
+                continue
+            k = mop.key(m)
+            vs = list(mop.value(m))
+            writers = a.writer_of.get(k, {})
+            if vs:
+                w = writers.get(vs[-1])
+                if w is not None and id(w[0]) != id(o):
+                    add(wr, idx[id(w[0])], idx[id(o)], "wr")
+            observed = set(vs)
+            for v, (wop, _final) in writers.items():
+                if v not in observed and is_ok(wop) \
+                        and id(wop) != id(o):
+                    add(rw, idx[id(o)], idx[id(wop)], "rw")
+    return txns, ww, wr, rw, edges, a, incompatible
+
+
+DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
+                     "internal", "duplicate-elements",
+                     "incompatible-order")
+
+
+def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
+    """Full list-append analysis. Returns {'valid?': ..,
+    'anomaly-types': [..], 'anomalies': {type: [case...]}}, matching the
+    reference checker's result shape (`tests/cycle/append.clj:28-55`)."""
+    hist = as_history(hist).index()
+    txns, ww, wr, rw, edges, a, incompatible = graph(hist)
+    found: dict[str, list] = {}
+
+    if a.duplicates:
+        found["duplicate-elements"] = a.duplicates
+    if incompatible:
+        found["incompatible-order"] = incompatible
+    g1a = a.g1a_cases()
+    if g1a:
+        found["G1a"] = g1a
+    g1b = a.g1b_cases()
+    if g1b:
+        found["G1b"] = g1b
+    internal = internal_cases(a.hist)
+    if internal:
+        found["internal"] = internal
+
+    cyc = kernels.analyze_graph(ww, wr, rw, mesh=mesh)
+    found.update(kernels.certificates(txns, edges, cyc))
+
+    reported = {t: cases for t, cases in found.items() if t in anomalies}
+    return {
+        "valid?": not reported,
+        "anomaly-types": sorted(reported),
+        "anomalies": reported,
+        "txn-count": len(txns),
+    }

@@ -1,0 +1,219 @@
+"""Elle-class rw-register checker (reference consumes
+`elle.rw-register/check` via `jepsen/src/jepsen/tests/cycle/wr.clj:14-54`,
+anomaly taxonomy documented there at lines 31-45).
+
+Txns mix ['w', k, v] and ['r', k, v] micro-ops over registers. Writes are
+assumed globally unique per key (duplicates are flagged); version order is
+only *partially* recoverable, from:
+
+  * the initial state: nil precedes every written value;
+  * intra-txn sequencing: a txn that observes u (by read or its own
+    write) and then writes v establishes u < v.
+
+Edges: wr from each value's writer to its external readers (exact); ww
+between writers of known-ordered values; rw from a reader of u to the
+writers of known successors of u (a read of nil anti-depends on every
+writer of that key). rw edges built from non-immediate successions are
+rw;ww* composites — sound for cycle detection and classification, since
+the composite still contains exactly one anti-dependency.
+
+Single-pass anomalies: G1a (aborted read), G1b (intermediate read — a
+read of a txn's non-final write), internal (txn disagrees with its own
+prior ops), duplicate writes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ... import txn as mop
+from ...history import history as as_history, is_fail, is_info, is_ok
+from . import kernels
+
+_INIT = object()  # the unwritten initial state (reads return None)
+
+
+def op_internal_case(op: dict) -> dict | None:
+    """A read must agree with the txn's own latest prior op on that key."""
+    known: dict[Any, Any] = {}
+    for m in op.get("value") or ():
+        k, v = mop.key(m), mop.value(m)
+        if mop.is_read(m):
+            if k in known and known[k] != v:
+                return {"op": op, "mop": list(m), "expected": known[k]}
+            known[k] = v
+        elif mop.is_write(m):
+            known[k] = v
+    return None
+
+
+def internal_cases(hist) -> list:
+    return [c for o in hist if is_ok(o)
+            for c in [op_internal_case(o)] if c is not None]
+
+
+class _Analysis:
+    def __init__(self, hist):
+        hist = as_history(hist).index().client_ops()
+        self.hist = hist
+        self.oks = [o for o in hist if is_ok(o)]
+        self.infos = [o for o in hist if is_info(o)
+                      and isinstance(o.get("value"), (list, tuple))]
+        self.fails = [o for o in hist if is_fail(o)]
+        # (k, v) -> (op, final?) over ok/info writes
+        self.writer_of: dict[tuple, tuple] = {}
+        self.duplicates: list = []
+        for o in self.oks + self.infos:
+            writes: dict[Any, list] = {}
+            for m in o.get("value") or ():
+                if mop.is_write(m):
+                    writes.setdefault(mop.key(m), []).append(mop.value(m))
+            for k, vs in writes.items():
+                for i, v in enumerate(vs):
+                    if (k, v) in self.writer_of:
+                        self.duplicates.append(
+                            {"key": k, "value": v,
+                             "ops": [self.writer_of[(k, v)][0], o]})
+                    self.writer_of[(k, v)] = (o, i == len(vs) - 1)
+        self.failed_writes = {
+            (mop.key(m), mop.value(m)): o
+            for o in self.fails
+            for m in (o.get("value") or ())
+            if mop.is_write(m)}
+
+    def version_pairs(self):
+        """Known per-key order pairs {k: set of (u, v)} with u possibly
+        _INIT, from intra-txn sequencing."""
+        pairs: dict[Any, set] = {}
+        for o in self.oks:
+            cur: dict[Any, Any] = {}
+            for m in o.get("value") or ():
+                k, v = mop.key(m), mop.value(m)
+                if mop.is_read(m):
+                    cur[k] = _INIT if v is None else v
+                else:
+                    u = cur.get(k)
+                    if u is not None and u != v:
+                        pairs.setdefault(k, set()).add((u, v))
+                    cur[k] = v
+        return pairs
+
+    def g1a_cases(self) -> list:
+        cases = []
+        for o in self.oks:
+            for m in o.get("value") or ():
+                if mop.is_read(m) and mop.value(m) is not None:
+                    w = self.failed_writes.get((mop.key(m), mop.value(m)))
+                    if w is not None:
+                        cases.append({"op": o, "mop": list(m),
+                                      "writer": w})
+        return cases
+
+    def g1b_cases(self) -> list:
+        cases = []
+        for o in self.oks:
+            for m in o.get("value") or ():
+                if mop.is_read(m) and mop.value(m) is not None:
+                    w = self.writer_of.get((mop.key(m), mop.value(m)))
+                    if w is not None and not w[1] and id(w[0]) != id(o):
+                        cases.append({"op": o, "mop": list(m),
+                                      "writer": w[0]})
+        return cases
+
+
+def graph(hist):
+    """(txns, ww, wr, rw, edges, analysis) — see module docstring for the
+    edge-inference rules."""
+    a = _Analysis(hist)
+    txns = a.oks + a.infos
+    idx = {id(o): i for i, o in enumerate(txns)}
+    n = len(txns)
+    ww = np.zeros((n, n), bool)
+    wr = np.zeros((n, n), bool)
+    rw = np.zeros((n, n), bool)
+    edges: dict[tuple, set] = {}
+
+    def add(mat, i, j, typ):
+        if i == j:
+            return
+        mat[i, j] = True
+        edges.setdefault((i, j), set()).add(typ)
+
+    # wr: writer -> external readers (exact)
+    for o in a.oks:
+        for k, v in mop.ext_reads(o.get("value") or ()).items():
+            if v is None:
+                continue
+            w = a.writer_of.get((k, v))
+            if w is not None:
+                add(wr, idx[id(w[0])], idx[id(o)], "wr")
+
+    pairs = a.version_pairs()
+    writers_by_key: dict[Any, list] = {}
+    for (k, v), w in a.writer_of.items():
+        writers_by_key.setdefault(k, []).append((v, w[0]))
+
+    # ww between known-ordered writes
+    for k, ps in pairs.items():
+        for u, v in ps:
+            wv = a.writer_of.get((k, v))
+            if wv is None:
+                continue
+            if u is not _INIT:
+                wu = a.writer_of.get((k, u))
+                if wu is not None:
+                    add(ww, idx[id(wu[0])], idx[id(wv[0])], "ww")
+
+    # rw: external reader of u -> writers of known successors of u;
+    # a read of nil anti-depends on every writer of that key
+    succ: dict[tuple, list] = {}
+    for k, ps in pairs.items():
+        for u, v in ps:
+            succ.setdefault((k, u), []).append(v)
+    for o in a.oks:
+        for k, v in mop.ext_reads(o.get("value") or ()).items():
+            if v is None:
+                for _, w in writers_by_key.get(k, ()):
+                    add(rw, idx[id(o)], idx[id(w)], "rw")
+            else:
+                for v2 in succ.get((k, v), ()):
+                    w2 = a.writer_of.get((k, v2))
+                    if w2 is not None:
+                        add(rw, idx[id(o)], idx[id(w2[0])], "rw")
+    return txns, ww, wr, rw, edges, a
+
+
+DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
+                     "internal", "duplicate-writes")
+
+
+def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
+    """Full rw-register analysis; result shape mirrors the reference
+    checker (`tests/cycle/wr.clj:46-54`)."""
+    hist = as_history(hist).index()
+    txns, ww, wr, rw, edges, a = graph(hist)
+    found: dict[str, list] = {}
+    if a.duplicates:
+        found["duplicate-writes"] = a.duplicates
+    g1a = a.g1a_cases()
+    if g1a:
+        found["G1a"] = g1a
+    g1b = a.g1b_cases()
+    if g1b:
+        found["G1b"] = g1b
+    internal = internal_cases(a.hist)
+    if internal:
+        found["internal"] = internal
+
+    cyc = kernels.analyze_graph(ww, wr, rw, mesh=mesh)
+    found.update(kernels.certificates(txns, edges, cyc))
+
+    reported = {t: cases for t, cases in found.items() if t in anomalies}
+    return {
+        "valid?": not reported,
+        "anomaly-types": sorted(reported),
+        "anomalies": reported,
+        "txn-count": len(txns),
+    }

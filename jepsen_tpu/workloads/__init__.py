@@ -7,8 +7,8 @@ with at least 'generator' and 'checker' entries, merged into a test map by
 suites (pattern: `zookeeper.clj:106-129`).
 """
 
-from . import adya, bank, causal, causal_reverse, linearizable_register, \
-    long_fork  # noqa: F401
+from . import adya, append, bank, causal, causal_reverse, \
+    linearizable_register, long_fork, wr  # noqa: F401
 
-__all__ = ["adya", "bank", "causal", "causal_reverse",
-           "linearizable_register", "long_fork"]
+__all__ = ["adya", "append", "bank", "causal", "causal_reverse",
+           "linearizable_register", "long_fork", "wr"]
