@@ -1,0 +1,99 @@
+"""Retrying remote wrapper: reconnect + bounded retries around any Remote.
+
+Reference: `jepsen/src/jepsen/control/retry.clj` — wraps a Remote in a
+stateful auto-reconnecting connection and retries failed operations
+**5 times with ~100 ms backoff** (`retry.clj:15-30`), because transient
+SSH failures (EOFs, dropped channels, slow sshds) are routine during
+fault injection.
+
+Commands that fail with a *nonzero exit status* are NOT retried — that's
+a real result, not transport trouble. Only transport-level exceptions
+trigger reconnect+retry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .core import Remote, RemoteError
+
+RETRIES = 5
+BACKOFF_S = 0.1
+
+
+class RetryRemote(Remote):
+    def __init__(self, inner: Remote, retries: int = RETRIES,
+                 backoff_s: float = BACKOFF_S):
+        self.inner = inner          # unconnected prototype
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.conn_spec = None
+        self._conn: Remote | None = None
+        self._lock = threading.Lock()
+
+    def connect(self, conn_spec: dict) -> "RetryRemote":
+        r = RetryRemote(self.inner, self.retries, self.backoff_s)
+        r.conn_spec = dict(conn_spec)
+        r._conn = self.inner.connect(conn_spec)
+        return r
+
+    def disconnect(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.disconnect()
+                self._conn = None
+
+    def _reconnect(self) -> Remote:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.disconnect()
+                except Exception:
+                    pass
+            self._conn = self.inner.connect(self.conn_spec)
+            return self._conn
+
+    def _with_retry(self, f):
+        last = None
+        for attempt in range(self.retries + 1):
+            conn = self._conn
+            if conn is None:
+                try:
+                    conn = self._reconnect()
+                except Exception as e:
+                    last = e
+                    time.sleep(self.backoff_s)
+                    continue
+            try:
+                return f(conn)
+            except RemoteError as e:
+                # A real command result: propagate, don't retry.
+                if e.exit is not None and e.exit >= 0:
+                    raise
+                last = e
+            except Exception as e:
+                last = e
+            time.sleep(self.backoff_s)
+            try:
+                self._reconnect()
+            except Exception as e:
+                last = e
+        raise RemoteError(f"remote operation failed after "
+                          f"{self.retries + 1} attempts: {last}",
+                          getattr(last, "result", None) or {})
+
+    def execute(self, context, action) -> dict:
+        return self._with_retry(lambda c: c.execute(context, action))
+
+    def upload(self, context, local_paths, remote_path, opts=None):
+        return self._with_retry(
+            lambda c: c.upload(context, local_paths, remote_path, opts))
+
+    def download(self, context, remote_paths, local_path, opts=None):
+        return self._with_retry(
+            lambda c: c.download(context, remote_paths, local_path, opts))
+
+
+def remote(inner: Remote, **kw) -> RetryRemote:
+    return RetryRemote(inner, **kw)

@@ -1,0 +1,355 @@
+"""Control layer: shell escaping, sudo wrapping, DSL scoping, remotes.
+
+Mirrors `jepsen/test/jepsen/control_test.clj` and the escaping semantics
+of `control/core.clj:60-153`, but hermetically: the DummyRemote journals
+commands instead of SSHing.
+"""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.control import core as ctl
+from jepsen_tpu.control import dummy, retry, util as cu
+from jepsen_tpu.control.core import RemoteError, env, escape, lit
+
+
+class TestEscape:
+    def test_nil_and_empty(self):
+        assert escape(None) == ""
+        assert escape("") == '""'
+
+    def test_plain(self):
+        assert escape("foo") == "foo"
+        assert escape(123) == "123"
+
+    def test_specials_quoted(self):
+        assert escape("foo bar") == '"foo bar"'
+        assert escape("a$b") == '"a\\$b"'
+        assert escape('say "hi"') == '"say \\"hi\\""'
+        assert escape("back\\slash") == '"back\\\\slash"'
+        assert escape("semi;colon") == '"semi;colon"'
+        assert escape("glob*") == '"glob*"'
+
+    def test_literal_passthrough(self):
+        assert escape(lit("a | b")) == "a | b"
+
+    def test_redirects(self):
+        assert escape(">") == ">"
+        assert escape(">>") == ">>"
+        assert escape("<") == "<"
+
+    def test_sequences(self):
+        assert escape(["a", "b c"]) == 'a "b c"'
+
+
+class TestEnv:
+    def test_map(self):
+        e = env({"HOME": "/root", "SEEDS": "a b"})
+        assert isinstance(e, ctl.Literal)
+        assert e.string == 'HOME=/root SEEDS="a b"'
+
+    def test_passthrough(self):
+        assert env("X=1").string == "X=1"
+        assert env(lit("X=1")).string == "X=1"
+        assert env(None) is None
+
+    def test_bad(self):
+        with pytest.raises(TypeError):
+            env(42)
+
+
+class TestSudo:
+    def test_no_sudo(self):
+        a = {"cmd": "ls"}
+        assert ctl.wrap_sudo({}, a) == a
+
+    def test_sudo_wraps(self):
+        out = ctl.wrap_sudo({"sudo": "root"}, {"cmd": "ls /tmp"})
+        assert out["cmd"] == 'sudo -k -S -u root bash -c "ls /tmp"'
+
+    def test_sudo_password_on_stdin(self):
+        out = ctl.wrap_sudo({"sudo": "root", "sudo-password": "hunter2"},
+                            {"cmd": "ls", "in": "data"})
+        assert out["in"] == "hunter2\ndata"
+
+
+class TestNonzeroExit:
+    def test_ok(self):
+        r = {"exit": 0, "out": "hi"}
+        assert ctl.throw_on_nonzero_exit(r) is r
+
+    def test_throws(self):
+        with pytest.raises(RemoteError) as ei:
+            ctl.throw_on_nonzero_exit(
+                {"exit": 2, "err": "boom", "host": "n1",
+                 "action": {"cmd": "false"}})
+        assert ei.value.exit == 2
+
+
+class TestDSL:
+    def test_exec_escapes_and_returns_stdout(self):
+        r = dummy.DummyRemote(responses={r"\becho": "hello\n"})
+        with control.with_remote(r), control.on("n1"):
+            assert control.exec_("echo", "hello world") == "hello"
+        host, ctx, action = r.log[0]
+        assert host == "n1"
+        # the DSL wraps every action in the bound dir (default "/")
+        assert action["cmd"] == 'cd /; echo "hello world"'
+
+    def test_cd_su_scoping(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            with control.cd("/opt"), control.su():
+                control.exec_("ls")
+            control.exec_("ls")
+        (_, ctx1, _), (_, ctx2, _) = r.log
+        assert ctx1 == {"dir": "/opt", "sudo": "root",
+                        "sudo-password": None}
+        assert ctx2["sudo"] is None and ctx2["dir"] == "/"
+
+    def test_expand_path(self):
+        with control.binding(dir="/opt/db"):
+            assert control.expand_path("logs") == "/opt/db/logs"
+            assert control.expand_path("/abs") == "/abs"
+
+    def test_no_session_raises(self):
+        with pytest.raises(RemoteError):
+            control.exec_("ls")
+
+    def test_on_nodes_parallel_sessions(self):
+        r = dummy.DummyRemote()
+        sessions = {n: r.connect({"host": n}) for n in ("n1", "n2", "n3")}
+        test = {"nodes": ["n1", "n2", "n3"], "sessions": sessions}
+
+        def f(test, node):
+            control.exec_("hostname")
+            return control.var("host")
+
+        res = control.on_nodes(test, f)
+        assert res == {"n1": "n1", "n2": "n2", "n3": "n3"}
+        assert {h for h, _, _ in r.log} == {"n1", "n2", "n3"}
+
+    def test_on_many(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r):
+            res = control.on_many(["a", "b"], lambda: control.var("host"))
+        assert res == {"a": "a", "b": "b"}
+
+    def test_bindings_are_thread_local(self):
+        seen = {}
+
+        def worker():
+            seen["child"] = control.var("dir")
+
+        with control.binding(dir="/parent"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["child"] == "/"  # child thread gets defaults
+
+    def test_with_ssh(self):
+        with control.with_ssh({"username": "admin", "dummy": True,
+                               "port": 2222}):
+            spec = control.conn_spec()
+        assert spec["username"] == "admin"
+        assert spec["port"] == 2222
+        assert spec["dummy"] is True
+
+
+class TestUploadDownload:
+    def test_upload_str_records_content(self, tmp_path):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            control.upload_str("config contents", "/etc/db.conf")
+        assert r.files["/etc/db.conf"] == b"config contents"
+
+    def test_download_logged(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            control.download("/var/log/db.log", "local/")
+        assert any("download" in a for _, _, a in r.log)
+
+
+class TestRetryRemote:
+    def test_retries_transport_errors(self):
+        calls = {"n": 0}
+
+        class Flaky(dummy.DummyRemote):
+            def execute(self, context, action):
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise OSError("connection reset")
+                return super().execute(context, action)
+
+        f = Flaky()
+        # share the prototype so reconnects reuse the same counter
+        f.connect = lambda spec: f
+        r = retry.RetryRemote(f, backoff_s=0.001).connect({"host": "n1"})
+        res = r.execute({}, {"cmd": "ls"})
+        assert res["exit"] == 0
+        assert calls["n"] == 3
+
+    def test_does_not_retry_nonzero_exit(self):
+        calls = {"n": 0}
+
+        class Failing(dummy.DummyRemote):
+            def execute(self, context, action):
+                calls["n"] += 1
+                raise RemoteError("bad", {"exit": 7})
+
+        f = Failing()
+        f.connect = lambda spec: f
+        r = retry.RetryRemote(f, backoff_s=0.001).connect({"host": "n1"})
+        with pytest.raises(RemoteError):
+            r.execute({}, {"cmd": "false"})
+        assert calls["n"] == 1
+
+    def test_gives_up_after_retries(self):
+        class Dead(dummy.DummyRemote):
+            def execute(self, context, action):
+                raise OSError("nope")
+
+        d = Dead()
+        d.connect = lambda spec: d
+        r = retry.RetryRemote(d, retries=2, backoff_s=0.001).connect(
+            {"host": "n1"})
+        with pytest.raises(RemoteError, match="3 attempts"):
+            r.execute({}, {"cmd": "ls"})
+
+
+class TestControlUtil:
+    def test_exists_and_ls(self):
+        r = dummy.DummyRemote(responses={
+            r"\bstat": "ok",
+            r"ls -A": "a\nb\n\nc\n",
+        })
+        with control.with_remote(r), control.on("n1"):
+            assert cu.exists("/etc") is True
+            assert cu.ls("/etc") == ["a", "b", "c"]
+            assert cu.ls_full("/etc") == ["/etc/a", "/etc/b", "/etc/c"]
+
+    def test_write_file_stdin(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            cu.write_file("hello\n", "/tmp/x")
+        _, _, action = r.log[0]
+        assert action["cmd"].endswith("cat > /tmp/x")
+        assert action["in"] == "hello\n"
+
+    def test_write_file_sudo(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"), control.su():
+            cu.write_file("x", "/etc/hosts")
+        _, _, action = r.log[0]
+        assert action["cmd"].startswith("sudo -k -S -u root bash -c ")
+
+    def test_grepkill_pipeline(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            cu.grepkill("mydb", "term")
+        cmd = r.log[0][2]["cmd"]
+        assert "ps aux | grep mydb | grep -v grep" in cmd
+        assert "kill -TERM" in cmd
+
+    def test_start_daemon(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            res = cu.start_daemon(
+                {"logfile": "/var/log/db.log", "pidfile": "/run/db.pid",
+                 "chdir": "/opt/db", "env": {"PORT": 1234}},
+                "/opt/db/bin/db", "--serve")
+        assert res == "started"
+        cmd = r.log[-1][2]["cmd"]
+        assert "start-stop-daemon" in cmd
+        assert "--make-pidfile" in cmd
+        assert "--startas /opt/db/bin/db" in cmd
+        assert "PORT=1234" in cmd
+        assert ">> /var/log/db.log" in cmd
+
+    def test_stop_daemon_by_cmd(self):
+        r = dummy.DummyRemote()
+        with control.with_remote(r), control.on("n1"):
+            cu.stop_daemon("/run/db.pid", cmd="db")
+        cmds = [a["cmd"] for _, _, a in r.log]
+        assert any("killall -9 -w db" in c for c in cmds)
+
+    def test_daemon_running_states(self):
+        alive = dummy.DummyRemote(responses={r"\bcat": "42",
+                                             r"\bps": "42"})
+        with control.with_remote(alive), control.on("n1"):
+            assert cu.daemon_running("/run/db.pid") is True
+
+        def no_proc(ctx, action):
+            return {"exit": 1, "err": "no such process"}
+
+        dead = dummy.DummyRemote(responses={r"\bcat": "42",
+                                            r"\bps": no_proc})
+        with control.with_remote(dead), control.on("n1"):
+            assert cu.daemon_running("/run/db.pid") is False
+
+
+class TestFsCache:
+    def test_round_trips(self, tmp_path):
+        from jepsen_tpu import fs_cache
+
+        fs_cache.set_dir(str(tmp_path / "cache"))
+        try:
+            assert not fs_cache.cached("k")
+            fs_cache.save_string("v1", "k")
+            assert fs_cache.load_string("k") == "v1"
+            fs_cache.save_data({"a": [1, 2]}, ("nested", "path", 3))
+            assert fs_cache.load_data(("nested", "path", 3)) == \
+                {"a": [1, 2]}
+            # unsafe characters are escaped, not traversed
+            fs_cache.save_string("x", "../../evil")
+            assert fs_cache.load_string("../../evil") == "x"
+            f = fs_cache.file_path("../../evil")
+            assert str(tmp_path) in f
+        finally:
+            fs_cache.set_dir(fs_cache.DEFAULT_DIR)
+
+    def test_fetch_computes_once(self, tmp_path):
+        from jepsen_tpu import fs_cache
+
+        fs_cache.set_dir(str(tmp_path / "cache"))
+        try:
+            calls = {"n": 0}
+
+            def miss():
+                calls["n"] += 1
+                return b"artifact"
+
+            f1 = fs_cache.fetch("big.tar", miss)
+            f2 = fs_cache.fetch("big.tar", miss)
+            assert f1 == f2 and calls["n"] == 1
+        finally:
+            fs_cache.set_dir(fs_cache.DEFAULT_DIR)
+
+
+class TestReconnect:
+    def test_with_conn_reopens_on_error(self):
+        from jepsen_tpu import reconnect
+
+        opened = {"n": 0}
+        w = reconnect.wrapper(open=lambda: opened.__setitem__(
+            "n", opened["n"] + 1) or opened["n"])
+        assert w.with_conn(lambda c: c) == 1
+        with pytest.raises(ValueError):
+            w.with_conn(lambda c: (_ for _ in ()).throw(ValueError()))
+        assert w.with_conn(lambda c: c) == 2  # reopened
+
+    def test_concurrent_readers(self):
+        from jepsen_tpu import reconnect
+
+        w = reconnect.wrapper(open=lambda: object())
+        results = []
+
+        def use():
+            results.append(w.with_conn(lambda c: c))
+
+        ts = [threading.Thread(target=use) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(set(map(id, results))) == 1
