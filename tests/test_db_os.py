@@ -1,0 +1,217 @@
+"""DB/OS protocols: capabilities, cycle retries, tcpdump, debian, faketime.
+
+Hermetic via DummyRemote; mirrors the behaviors of `jepsen/src/jepsen/
+{db,os,os/debian,faketime}.clj`.
+"""
+
+import pytest
+
+from jepsen_tpu import control, db, faketime
+from jepsen_tpu.control import dummy
+from jepsen_tpu.os_ import Noop as OsNoop, debian, ubuntu
+
+
+def make_test(remote, nodes=("n1", "n2", "n3")):
+    sessions = {n: remote.connect({"host": n}) for n in nodes}
+    return {"nodes": list(nodes), "sessions": sessions}
+
+
+class TestCapabilities:
+    def test_noop_supports_nothing(self):
+        for cap in ("process", "pause", "primary", "log-files"):
+            assert not db.supports(db.noop, cap)
+
+    def test_full_db(self):
+        class Full(db.DB, db.Process, db.Pause, db.Primary, db.LogFiles):
+            def start(self, test, node): ...
+            def kill(self, test, node): ...
+            def pause(self, test, node): ...
+            def resume(self, test, node): ...
+            def primaries(self, test): return []
+
+        d = Full()
+        for cap in ("process", "pause", "primary", "log-files"):
+            assert db.supports(d, cap)
+
+    def test_tcpdump_has_logfiles(self):
+        t = db.tcpdump({"ports": [4000, 5000]})
+        assert db.supports(t, "log-files")
+        assert t._filter_str() == "port 4000 and port 5000"
+
+
+class TestCycle:
+    def test_teardown_then_setup_all_nodes(self):
+        events = []
+
+        class D(db.DB):
+            def setup(self, test, node):
+                events.append(("setup", node))
+
+            def teardown(self, test, node):
+                events.append(("teardown", node))
+
+        r = dummy.DummyRemote()
+        test = make_test(r)
+        test["db"] = D()
+        db.cycle(test)
+        downs = [e for e in events if e[0] == "teardown"]
+        ups = [e for e in events if e[0] == "setup"]
+        assert len(downs) == 3 and len(ups) == 3
+        assert events.index(ups[0]) > events.index(downs[-1])
+
+    def test_primary_setup_on_first_node(self):
+        prim = []
+
+        class D(db.DB, db.Primary):
+            def primaries(self, test):
+                return [test["nodes"][0]]
+
+            def setup_primary(self, test, node):
+                prim.append(node)
+
+        r = dummy.DummyRemote()
+        test = make_test(r)
+        test["db"] = D()
+        db.cycle(test)
+        assert prim == ["n1"]
+
+    def test_retries_on_setup_failed(self):
+        attempts = {"n": 0}
+
+        class Flaky(db.DB):
+            def setup(self, test, node):
+                if node == "n2" and attempts["n"] < 2:
+                    attempts["n"] += 1
+                    raise db.SetupFailed("not ready")
+
+        r = dummy.DummyRemote()
+        test = make_test(r)
+        test["db"] = Flaky()
+        db.cycle(test)
+        assert attempts["n"] == 2
+
+    def test_gives_up_after_three_tries(self):
+        class Broken(db.DB):
+            def setup(self, test, node):
+                raise db.SetupFailed("never works")
+
+        r = dummy.DummyRemote()
+        test = make_test(r)
+        test["db"] = Broken()
+        with pytest.raises(db.SetupFailed):
+            db.cycle(test)
+
+
+class TestTcpdump:
+    def test_setup_starts_capture(self):
+        r = dummy.DummyRemote()
+        t = db.tcpdump({"ports": [2181]})
+        with control.with_remote(r), control.on("n1"):
+            t.setup({}, "n1")
+        cmds = [a.get("cmd", "") for _, _, a in r.log]
+        assert any("tcpdump" in c0 and "start-stop-daemon" in c0
+                   for c0 in cmds)
+
+    def test_teardown_kills_and_cleans(self):
+        def no_pid(ctx, action):
+            return {"exit": 1, "err": "no such file"}
+
+        r = dummy.DummyRemote(responses={r"\bcat /tmp/jepsen": no_pid})
+        t = db.tcpdump({})
+        with control.with_remote(r), control.on("n1"):
+            t.teardown({}, "n1")
+        cmds = [a.get("cmd", "") for _, _, a in r.log]
+        assert any("rm -rf /tmp/jepsen/tcpdump" in c0 for c0 in cmds)
+
+
+class TestDebian:
+    def test_install_skips_installed(self):
+        r = dummy.DummyRemote(responses={
+            r"dpkg --get-selections":
+                "vim\tinstall\nwget\tinstall\n",
+        })
+        with control.with_remote(r), control.on("n1"):
+            debian.install(["vim", "wget"])
+        cmds = [a.get("cmd", "") for _, _, a in r.log]
+        assert not any("apt-get install" in c0 for c0 in cmds)
+
+    def test_install_missing(self):
+        r = dummy.DummyRemote(responses={
+            r"dpkg --get-selections": "vim\tinstall\n",
+            r"\bdate": "1000000000",
+            r"\bstat -c": "999999999",
+        })
+        with control.with_remote(r), control.on("n1"):
+            debian.install(["vim", "curl"])
+        cmds = [a.get("cmd", "") for _, _, a in r.log]
+        assert any("apt-get install -y curl" in c0 for c0 in cmds)
+
+    def test_hostfile_rewrite(self):
+        r = dummy.DummyRemote(responses={
+            r"cat /etc/hosts": "127.0.0.1\tn1.local\n10.0.0.2 n2\n",
+        })
+        with control.with_remote(r), control.on("n1"):
+            debian.setup_hostfile()
+        writes = [a for _, _, a in r.log if "cat >" in a.get("cmd", "")]
+        assert writes and "127.0.0.1\tlocalhost" in writes[0]["in"]
+
+    def test_installed_version(self):
+        r = dummy.DummyRemote(responses={
+            r"apt-cache policy":
+                "vim:\n  Installed: 2:8.2.2434\n  Candidate: x\n"})
+        with control.with_remote(r), control.on("n1"):
+            assert debian.installed_version("vim") == "2:8.2.2434"
+
+    def test_ubuntu_setup_heals_net(self):
+        healed = []
+
+        class Net:
+            def heal(self, test):
+                healed.append(True)
+
+        r = dummy.DummyRemote(responses={
+            r"dpkg --get-selections":
+                "\n".join(f"{p}\tinstall" for p in ubuntu.Ubuntu.packages),
+            r"\bdate": "1000000000",
+            r"\bstat -c": "1000000000",
+            r"cat /etc/hosts": "127.0.0.1\tlocalhost\n",
+        })
+        with control.with_remote(r), control.on("n1"):
+            ubuntu.os.setup({"net": Net()}, "n1")
+        assert healed == [True]
+
+
+class TestFaketime:
+    def test_script(self):
+        s = faketime.script("/opt/db/bin/db", -5, 1.5)
+        assert s.startswith("#!/bin/bash")
+        assert 'faketime -m -f "-5s x1.5"' in s
+        assert '"$@"' in s
+
+    def test_wrap_moves_original_once(self):
+        # stat fails => original not yet moved -> mv happens
+        r = dummy.DummyRemote(
+            responses={r"\bstat": lambda c, a: {"exit": 1}})
+        with control.with_remote(r), control.on("n1"):
+            faketime.wrap("/opt/db/bin/db", 0, 2.0)
+        cmds = [a.get("cmd", "") for _, _, a in r.log]
+        assert any("mv /opt/db/bin/db /opt/db/bin/db.no-faketime" in c0
+                   for c0 in cmds)
+        assert any("chmod a+x /opt/db/bin/db" in c0 for c0 in cmds)
+
+    def test_rand_factor_bounds(self):
+        import random
+
+        rng = random.Random(7)
+        for _ in range(100):
+            v = faketime.rand_factor(2.5, rng)
+            hi = 2 / (1 + 1 / 2.5)
+            assert hi / 2.5 <= v <= hi
+
+    def test_unwrap_restores(self):
+        r = dummy.DummyRemote(responses={r"\bstat": "ok"})
+        with control.with_remote(r), control.on("n1"):
+            faketime.unwrap("/opt/db/bin/db")
+        cmds = [a.get("cmd", "") for _, _, a in r.log]
+        assert any("mv /opt/db/bin/db.no-faketime /opt/db/bin/db" in c0
+                   for c0 in cmds)
