@@ -1,0 +1,266 @@
+"""Nemesis packages + membership: node specs, package gating by DB
+capabilities, composition, and the membership state machine loop.
+
+Mirrors `jepsen/test/jepsen/nemesis/combined_test.clj` behaviors.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import control, db, generator as gen, net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import dummy
+from jepsen_tpu.nemesis import combined, membership
+from jepsen_tpu.util import majority
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class FullDB(db.DB, db.Process, db.Pause, db.Primary):
+    def __init__(self):
+        self.events = []
+
+    def start(self, test, node):
+        self.events.append(("start", node))
+        return "started"
+
+    def kill(self, test, node):
+        self.events.append(("kill", node))
+        return "killed"
+
+    def pause(self, test, node):
+        self.events.append(("pause", node))
+        return "paused"
+
+    def resume(self, test, node):
+        self.events.append(("resume", node))
+        return "resumed"
+
+    def primaries(self, test):
+        return [test["nodes"][0]]
+
+
+def make_test(nodes=NODES):
+    r = dummy.DummyRemote()
+    sessions = {n: r.connect({"host": n}) for n in nodes}
+    return {"nodes": list(nodes), "sessions": sessions, "net": net.noop,
+            "concurrency": 2}
+
+
+class TestNodeSpecs:
+    def test_one(self):
+        test = make_test()
+        assert len(combined.db_nodes(test, db.noop, "one")) == 1
+
+    def test_minority_majority(self):
+        test = make_test()
+        n = len(NODES)
+        assert len(combined.db_nodes(test, db.noop, "minority")) == \
+            majority(n) - 1
+        assert len(combined.db_nodes(test, db.noop, "majority")) == \
+            majority(n)
+
+    def test_all_and_explicit(self):
+        test = make_test()
+        assert combined.db_nodes(test, db.noop, "all") == NODES
+        assert combined.db_nodes(test, db.noop, ["n2"]) == ["n2"]
+
+    def test_nil_nonempty(self):
+        test = make_test()
+        for _ in range(20):
+            ns = combined.db_nodes(test, db.noop, None)
+            assert 1 <= len(ns) <= 5
+
+    def test_primaries(self):
+        test = make_test()
+        assert combined.db_nodes(test, FullDB(), "primaries") == ["n1"]
+
+    def test_node_specs_reflect_primary(self):
+        assert "primaries" not in combined.node_specs(db.noop)
+        assert "primaries" in combined.node_specs(FullDB())
+
+    def test_minority_third(self):
+        assert combined.minority_third(3) == 0
+        assert combined.minority_third(5) == 1
+        assert combined.minority_third(6) == 1
+        assert combined.minority_third(9) == 2
+        assert combined.minority_third(10) == 3
+
+
+class TestDBPackage:
+    def test_nemesis_routes_to_db(self):
+        d = FullDB()
+        test = make_test()
+        pkg = combined.db_package(
+            {"db": d, "faults": {"kill", "pause"}})
+        n = pkg["nemesis"].setup(test)
+        out = n.invoke(test, {"type": "info", "f": "kill",
+                              "value": "all"})
+        assert set(out["value"]) == set(NODES)
+        assert all(v == "killed" for v in out["value"].values())
+        assert len([e for e in d.events if e[0] == "kill"]) == 5
+
+    def test_gated_by_faults(self):
+        pkg = combined.db_package({"db": FullDB(),
+                                   "faults": {"partition"}})
+        assert pkg["generator"] is None
+        assert pkg["final-generator"] is None
+
+    def test_gated_by_capabilities(self):
+        pkg = combined.db_package({"db": db.noop,
+                                   "faults": {"kill", "pause"}})
+        # noop DB has no Process/Pause: no generator modes at all
+        assert pkg["generator"] is None
+
+
+class TestPartitionPackage:
+    def test_grudge_specs(self):
+        test = make_test()
+        g = combined.grudge(test, db.noop, "one")
+        isolated = [n for n, v in g.items() if len(v) == 4]
+        assert len(isolated) == 1
+        g = combined.grudge(test, db.noop, "majority")
+        sizes = sorted(len(v) for v in g.values())
+        assert sizes == [2, 2, 2, 3, 3]
+        g = combined.grudge(test, FullDB(), "primaries")
+        assert g["n1"] == {"n2", "n3", "n4", "n5"}
+
+    def test_partition_nemesis_lifts_specs(self):
+        test = make_test()
+        pn = combined.PartitionNemesis(db.noop).setup(test)
+        out = pn.invoke(test, {"type": "info", "f": "start-partition",
+                               "value": "one"})
+        assert out["f"] == "start-partition"
+        assert out["value"][0] == "isolated"
+        out = pn.invoke(test, {"type": "info", "f": "stop-partition"})
+        assert out["f"] == "stop-partition"
+        assert out["value"] == "network-healed"
+
+
+class TestComposePackages:
+    def test_full_package_generates_and_routes(self):
+        d = FullDB()
+        test = make_test()
+        pkg = combined.nemesis_package(
+            {"db": d, "interval": 0.0001,
+             "faults": ["partition", "kill", "pause"]})
+        n = pkg["nemesis"].setup(test)
+        # drive the package generator deterministically
+        ctx = gen.context(test)
+        fs_seen = set()
+        g = pkg["generator"]
+        with gen.fixed_rng(7):
+            for _ in range(60):
+                res = gen.op(g, test, ctx)
+                if res is None:
+                    break
+                o, g = res
+                if o is gen.PENDING:
+                    ctx = ctx.with_time(ctx.time + 10_000_000)
+                    continue
+                o = {**o, "time": ctx.time}
+                fs_seen.add(o["f"])
+                out = n.invoke(test, o)
+                assert out["f"] == o["f"]
+                ctx = ctx.with_time(ctx.time + 10_000_000)
+                g = gen.update(g, test, ctx,
+                               {**out, "type": "info"})
+        assert "start-partition" in fs_seen or \
+            "stop-partition" in fs_seen
+        assert {"kill", "pause"} & fs_seen
+
+    def test_final_generators_sequence(self):
+        pkg = combined.nemesis_package(
+            {"db": FullDB(), "faults": ["partition", "kill"]})
+        finals = pkg["final-generator"]
+        assert finals is not None
+
+    def test_perf_union(self):
+        pkg = combined.nemesis_package(
+            {"db": FullDB(),
+             "faults": ["partition", "kill", "pause", "clock"]})
+        names = {p[0] for p in pkg["perf"]}
+        assert names == {"partition", "clock", "kill", "pause"}
+
+    def test_f_map_lifts_package(self):
+        pkg = combined.partition_package(
+            {"db": db.noop, "faults": {"partition"}})
+        lifted = combined.f_map(lambda f: f"db1-{f}", pkg)
+        test = make_test()
+        n = lifted["nemesis"].setup(test)
+        out = n.invoke(test, {"type": "info",
+                              "f": "db1-start-partition",
+                              "value": "one"})
+        assert out["f"] == "db1-start-partition"
+        names = {p[0] for p in lifted["perf"]}
+        assert names == {"db1-partition"}
+
+
+class CounterState(membership.State):
+    """A toy membership state machine: ops remove a node; resolution
+    happens once a quorum of node views report it gone."""
+
+    def __init__(self):
+        self.removed = set()
+        self.acked = {}
+        self.node_views = {}
+        self.view = None
+
+    def node_view(self, test, node):
+        return sorted(set(test["nodes"]) - self.removed)
+
+    def merge_views(self, test):
+        views = list(self.node_views.values())
+        return views[0] if views else None
+
+    def fs(self):
+        return {"remove-node"}
+
+    def op(self, test):
+        candidates = sorted(set(test["nodes"]) - self.removed)
+        if len(candidates) <= majority(len(test["nodes"])):
+            return None
+        return {"type": "info", "f": "remove-node",
+                "value": candidates[-1]}
+
+    def invoke(self, test, op):
+        self.removed.add(op["value"])
+        return {**op, "value": [op["value"], "removed"]}
+
+    def resolve_op(self, test, op_pair):
+        op, op2 = op_pair
+        node = op["value"]
+        if node in self.removed and node not in self.acked:
+            self.acked[node] = True
+            return self
+        return None
+
+
+class TestMembership:
+    def test_package_gated(self):
+        assert membership.package({"faults": {"partition"}}) is None
+
+    def test_generator_and_invoke_resolve(self):
+        test = make_test()
+        pkg = membership.package(
+            {"faults": {"membership"}, "interval": 0.0001,
+             "membership": {"state": CounterState()}})
+        assert pkg is not None
+        n = pkg["nemesis"]
+        shared = pkg["state"]
+        st = shared.state
+        op = st.op(test)
+        assert op["f"] == "remove-node" and op["value"] == "n5"
+        out = n.invoke(test, op)
+        assert out["value"] == ["n5", "removed"]
+        # the invoke-path resolve already acked it
+        assert st.acked == {"n5": True}
+        assert shared.pending == {}
+        n.teardown(test)
+
+    def test_stops_at_majority(self):
+        test = make_test()
+        st = CounterState()
+        st.removed = {"n4", "n5"}
+        assert st.op(test) is None
