@@ -76,6 +76,23 @@ def binding(**kw):
         _env.vars.update(old)
 
 
+def bound_fn(f: Callable) -> Callable:
+    """Capture the current control bindings and re-establish them in
+    whatever thread later calls f — the reference's `bound-fn*`, needed
+    because worker threads see only default bindings."""
+    saved = dict(_env.vars)
+
+    def wrapper(*args, **kwargs):
+        old = _env.vars
+        _env.vars = dict(saved)
+        try:
+            return f(*args, **kwargs)
+        finally:
+            _env.vars = old
+
+    return wrapper
+
+
 def default_remote() -> Remote:
     """The bound remote, or the default: dummy when `dummy` is set,
     otherwise retry-wrapped OpenSSH (`control.clj:35-37` + the sshj/scp/

@@ -1,0 +1,347 @@
+"""Core orchestrator: entry point for all tests.
+
+Coordinates server setup, test execution, fault injection, and result
+analysis (reference `jepsen/src/jepsen/core.clj:326-401`). A test is a
+plain dict; `run(test)` takes it through the full lifecycle:
+
+1. set up the operating system on every node,
+2. teardown-then-setup the database (with primary setup if supported),
+3. set up the nemesis and one client per node,
+4. drive the generator through the interpreter, journaling a history,
+5. capture DB log files,
+6. tear down database and OS,
+7. index the history and run the checker — on TPU for the offloaded
+   checkers — writing results to the store.
+
+The run survives its own faults the way the reference does: resources
+started in parallel are unwound on partial failure (`with-resources`,
+`core.clj:70-91`), logs are snarfed even when the run crashes
+(`with-log-snarfing`, `core.clj:150-170`), and the history is persisted
+*before* analysis so a crashed checker still leaves data on disk
+(`save-1!`, `core.clj:397-398`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Any, Callable, Iterable
+
+from . import checker as jchecker
+from . import client as jclient
+from . import control
+from . import db as jdb
+from . import nemesis as jnemesis
+from . import store, util
+from .control import util as cu
+from .generator import interpreter
+from .history import History
+
+log = logging.getLogger(__name__)
+
+NO_BARRIER = "no-barrier"
+
+_snarf_lock = threading.Lock()
+
+
+def synchronize(test: dict, timeout_s: float = 60) -> None:
+    """Block until all nodes have arrived at the same point
+    (`core.clj:44-57`). Used by IO-heavy DB setup code; the default
+    60 s timeout keeps one crashed thread from deadlocking the rest."""
+    barrier = test.get("barrier")
+    if barrier == NO_BARRIER or barrier is None:
+        return
+    barrier.wait(timeout=timeout_s)
+
+
+def primary(test: dict) -> str:
+    """The test's primary node (`core.clj:65-68`)."""
+    return test["nodes"][0]
+
+
+@contextlib.contextmanager
+def with_resources(start: Callable, stop: Callable, resources: Iterable):
+    """Start resources in parallel, yield them, and ensure all are
+    stopped afterwards — including when some starts fail, in which case
+    the ones that did start are stopped and the first error is raised
+    (`core.clj:70-91`)."""
+    resources = list(resources)
+
+    def start1(r):
+        try:
+            return True, start(r)
+        except Exception as e:  # noqa: BLE001 — fcatch semantics
+            return False, e
+
+    results = util.real_pmap(start1, resources)
+    started = [v for ok, v in results if ok]
+    errors = [v for ok, v in results if not ok]
+
+    def stop_all():
+        def stop1(r):
+            try:
+                stop(r)
+            except Exception as e:  # noqa: BLE001
+                log.warning("error stopping resource: %s", e)
+        util.real_pmap(stop1, started)
+
+    if errors:
+        stop_all()
+        raise errors[0]
+    try:
+        yield started
+    finally:
+        stop_all()
+
+
+@contextlib.contextmanager
+def with_os(test: dict):
+    """OS setup on entry, teardown on exit (`core.clj:93-100`)."""
+    os = test["os"]
+    control.on_nodes(test, os.setup)
+    try:
+        yield test
+    finally:
+        control.on_nodes(test, os.teardown)
+
+
+def _short_paths(full_paths: list[str]) -> dict[str, str]:
+    """Map full remote paths to their shortest unambiguous suffixes:
+    the common *proper* directory prefix is dropped, so a lone file
+    keeps its basename (`util/drop-common-proper-prefix`)."""
+    if not full_paths:
+        return {}
+    split = [p.split("/") for p in full_paths]
+    prefix = util.longest_common_prefix(split)
+    # proper prefix: never swallow an entire path
+    n = min(len(prefix), min(len(s) for s in split) - 1)
+    return {p: "/".join(s[n:]) for p, s in zip(full_paths, split)}
+
+
+def snarf_logs(test: dict) -> None:
+    """Download DB log files for each node into the store directory and
+    refresh symlinks (`core.clj:102-136`)."""
+    with _snarf_lock:
+        db = test["db"]
+        if jdb.supports(db, "log-files") and test.get("sessions"):
+            log.info("Snarfing log files")
+
+            def snarf1(test, node):
+                full_paths = list(db.log_files(test, node) or [])
+                for remote, local in _short_paths(full_paths).items():
+                    if cu.exists(remote):
+                        dest = store.make_path(
+                            test, str(node), local.lstrip("/"))
+                        log.info("downloading %s to %s", remote, dest)
+                        try:
+                            control.download(remote, dest)
+                        except OSError as e:
+                            log.info("%s: %s", remote, e)
+
+            control.on_nodes(test, snarf1)
+        if test.get("name"):
+            store.update_symlinks(test)
+
+
+def maybe_snarf_logs(test: dict) -> None:
+    """Snarf logs, swallowing all errors — used on the abort path where
+    a snarfing error must not supersede the root cause
+    (`core.clj:138-148`)."""
+    try:
+        snarf_logs(test)
+    except Exception:  # noqa: BLE001
+        log.warning("Error snarfing logs and updating symlinks",
+                    exc_info=True)
+
+
+@contextlib.contextmanager
+def with_log_snarfing(test: dict):
+    """Evaluate body and ensure logs are snarfed afterwards, on success
+    and on crash alike (`core.clj:150-170`)."""
+    try:
+        yield test
+        snarf_logs(test)
+    finally:
+        maybe_snarf_logs(test)
+
+
+@contextlib.contextmanager
+def with_db(test: dict):
+    """DB cycle (teardown+setup, with retries) on entry; teardown on
+    exit unless `leave-db-running?` (`core.clj:172-181`)."""
+    try:
+        with with_log_snarfing(test):
+            jdb.cycle(test)
+            yield test
+    finally:
+        if not test.get("leave-db-running?"):
+            control.on_nodes(test, test["db"].teardown)
+
+
+@contextlib.contextmanager
+def with_client_nemesis_setup_teardown(test: dict):
+    """Set up the nemesis (concurrently) and one client per node before
+    the body; tear them all down after (`core.clj:183-212`). The set-up
+    nemesis replaces test['nemesis'] so the interpreter drives the
+    initialized instance."""
+    import concurrent.futures as _futures
+
+    client = test["client"]
+    nemesis = jnemesis.validate(test["nemesis"])
+
+    def open1(node):
+        c = client.open(test, node)
+        c.setup(test)
+        return c
+
+    with _futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="jepsen nemesis") as pool:
+        nf = pool.submit(nemesis.setup, test)
+        try:
+            clients = util.real_pmap(open1, test["nodes"])
+        except BaseException:
+            nf.cancel()
+            raise
+        test = {**test, "nemesis": nf.result() or nemesis}
+        try:
+            yield test
+        finally:
+            nt = pool.submit(test["nemesis"].teardown, test)
+
+            def close1(c):
+                try:
+                    c.teardown(test)
+                finally:
+                    c.close(test)
+
+            try:
+                util.real_pmap(close1, clients)
+            finally:
+                nt.result()
+
+
+def run_case(test: dict) -> History:
+    """Spawn nemesis and clients, run the generator, return the history
+    (`core.clj:214-219`)."""
+    with with_client_nemesis_setup_teardown(test) as test:
+        return interpreter.run(test)
+
+
+def analyze(test: dict) -> dict:
+    """Index the history, run the checker, persist results
+    (`core.clj:221-236`)."""
+    log.info("Analyzing...")
+    test = {**test, "history": History(test["history"]).index()}
+    test = {**test,
+            "results": jchecker.check_safe(test["checker"], test,
+                                           test["history"])}
+    log.info("Analysis complete")
+    if test.get("name"):
+        store.save_2(test)
+    return test
+
+
+def log_results(test: dict) -> dict:
+    """Log the results and a verdict (`core.clj:238-251`)."""
+    results = test.get("results", {})
+    verdict = {
+        False: "Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻",
+        jchecker.UNKNOWN: ("Errors occurred during analysis, "
+                           "but no anomalies found. ಠ~ಠ"),
+        True: "Everything looks good! ヽ('ー`)ノ",
+    }.get(results.get("valid?"), "")
+    err = results.get("error")
+    log.info("%s%s\n\n%s", _pstr(results),
+             f"\n\n{err}" if err else "", verdict)
+    return test
+
+
+def _pstr(x: Any, indent: int = 0) -> str:
+    pad = " " * indent
+    if isinstance(x, dict):
+        if not x:
+            return "{}"
+        lines = [f"{pad} {k!r}: {_pstr(v, indent + 1).lstrip()}"
+                 for k, v in x.items()]
+        return "{\n" + ",\n".join(lines) + "}"
+    return pad + repr(x)
+
+
+@contextlib.contextmanager
+def with_sessions(test: dict):
+    """Bind the test's remote + SSH options, open a session to every
+    node in parallel, and yield the test with a node→session map under
+    'sessions' (`core.clj:274-294`)."""
+    with control.with_remote(test.get("remote")), \
+            control.with_ssh(test.get("ssh") or {}):
+        with with_resources(control.bound_fn(control.session),
+                            control.disconnect,
+                            test["nodes"]) as sessions:
+            yield {**test,
+                   "sessions": dict(zip(test["nodes"], sessions))}
+
+
+@contextlib.contextmanager
+def with_logging(test: dict):
+    """Per-test log capture into the store directory; crashes are
+    logged so they land in the test's own log file
+    (`core.clj:296-308`)."""
+    store.start_logging(test)
+    try:
+        log.info("Running test: %s %s", test.get("name"),
+                 test.get("start-time"))
+        yield test
+    except BaseException:
+        log.warning("Test crashed!", exc_info=True)
+        raise
+    finally:
+        store.stop_logging()
+
+
+def prepare_test(test: dict) -> dict:
+    """Ensure start-time, concurrency, and barrier fields; always
+    succeeds, and is required before accessing the test's store
+    directory (`core.clj:310-324`)."""
+    test = dict(test)
+    if not test.get("start-time"):
+        test["start-time"] = store.start_time()
+    if not test.get("concurrency"):
+        test["concurrency"] = len(test.get("nodes") or [])
+    if not test.get("barrier"):
+        n = len(test.get("nodes") or [])
+        test["barrier"] = threading.Barrier(n) if n > 0 else NO_BARRIER
+    test.setdefault("os", _default_os())
+    test.setdefault("db", jdb.noop)
+    test.setdefault("client", jclient.noop)
+    test.setdefault("nemesis", jnemesis.noop)
+    test.setdefault("checker", jchecker.unbridled_optimism())
+    return test
+
+
+def _default_os():
+    from . import os_ as jos
+    return jos.noop
+
+
+def run(test: dict) -> dict:
+    """Run a test end to end and return it with 'history' and 'results'
+    (`core.clj:326-401`). See the module docstring for the lifecycle;
+    the docstring of the reference `run!` documents the test-map keys,
+    which this accepts unchanged (string keys)."""
+    test = prepare_test(test)
+    with with_logging(test):
+        with with_sessions(test) as stest:
+            with with_os(stest), with_db(stest):
+                with util.relative_time():
+                    hist = run_case(stest)
+                # strip run-state the analysis/persistence layers must
+                # not see (reference dissoc, core.clj:393-395)
+                done = {k: v for k, v in stest.items()
+                        if k not in ("barrier", "sessions")}
+                done["history"] = hist
+                log.info("Run complete, writing")
+                if done.get("name"):
+                    store.save_1(done)
+            done = analyze(done)
+        return log_results(done)

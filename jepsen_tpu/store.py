@@ -12,7 +12,9 @@ from __future__ import annotations
 import datetime as _dt
 import gzip
 import json
+import logging
 import os
+import threading as _threading
 from typing import Any, Iterable
 
 from .history import History, history
@@ -164,3 +166,78 @@ def delete(base: str = DEFAULT_BASE, name: str | None = None) -> None:
     target = os.path.join(base, name) if name else base
     if os.path.isdir(target):
         shutil.rmtree(target)
+
+
+# -- logging bootstrap ------------------------------------------------------
+#
+# Reference store.clj:431-459 (unilog): each run logs to its own
+# <dir>/jepsen.log in addition to the console, optionally as JSON.
+
+_log_handler = None
+_log_lock = _threading.Lock()
+
+LOG_FORMAT = "%(asctime)s{%(threadName)s} %(levelname)s [%(name)s] %(message)s"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record):
+        out = {
+            "time": self.formatTime(record),
+            "thread": record.threadName,
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        if record.stack_info:
+            out["stack"] = self.formatStack(record.stack_info)
+        return json.dumps(out)
+
+
+def _coerce_level(level) -> int:
+    if isinstance(level, int):
+        return level
+    s = str(level)
+    return int(s) if s.isdigit() else \
+        getattr(logging, s.upper(), logging.INFO)
+
+
+# levels to restore on stop: [(logger-name-or-None-for-root, level)]
+_saved_levels: list = []
+
+
+def start_logging(test) -> None:
+    """Route the root logger into this run's jepsen.log
+    (reference start-logging!, store.clj:431-453). Honors
+    test['logging']['json?'] and per-logger overrides."""
+    global _log_handler
+    if not test.get("name"):
+        return
+    with _log_lock:
+        stop_logging()
+        opts = test.get("logging") or {}
+        h = logging.FileHandler(make_path(test, "jepsen.log"))
+        h.setFormatter(_JsonFormatter() if opts.get("json?")
+                       else logging.Formatter(LOG_FORMAT))
+        root = logging.getLogger()
+        if root.level > logging.INFO or root.level == logging.NOTSET:
+            _saved_levels.append((None, root.level))
+            root.setLevel(logging.INFO)
+        for name, level in (opts.get("overrides") or {}).items():
+            logger = logging.getLogger(name)
+            _saved_levels.append((name, logger.level))
+            logger.setLevel(_coerce_level(level))
+        root.addHandler(h)
+        _log_handler = h
+
+
+def stop_logging() -> None:
+    global _log_handler
+    if _log_handler is not None:
+        logging.getLogger().removeHandler(_log_handler)
+        _log_handler.close()
+        _log_handler = None
+    while _saved_levels:
+        name, level = _saved_levels.pop()
+        logging.getLogger(name).setLevel(level)

@@ -104,3 +104,21 @@ def noop_test() -> dict:
         "generator": None,
         "checker": unbridled_optimism(),
     }
+
+
+class AtomDB:
+    """In-process 'database' over an AtomState: setup zeroes the cell,
+    teardown marks it 'done' (`tests.clj:27-43`)."""
+
+    def __init__(self, state: AtomState):
+        self.state = state
+
+    def setup(self, test, node):
+        self.state.reset(0)
+
+    def teardown(self, test, node):
+        self.state.reset("done")
+
+
+def atom_db(state: AtomState) -> AtomDB:
+    return AtomDB(state)

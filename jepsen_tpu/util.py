@@ -67,8 +67,9 @@ def nanos_to_secs(ns: float) -> float:
 
 def real_pmap(fn: Callable, coll: Iterable) -> list:
     """Parallel map over real threads, one per element. If any element's fn
-    throws, the first exception propagates (after all threads finish or are
-    cancelled) — mirrors reference real-pmap's crash behavior."""
+    throws, the first *interesting* exception propagates after all threads
+    finish — barrier/interrupt noise from sibling branches is passed over
+    so it can't mask a root cause (reference real-pmap / dom-top)."""
     items = list(coll)
     if not items:
         return []
@@ -76,15 +77,20 @@ def real_pmap(fn: Callable, coll: Iterable) -> list:
         return [fn(items[0])]
     with concurrent.futures.ThreadPoolExecutor(max_workers=len(items)) as ex:
         futures = [ex.submit(fn, x) for x in items]
-        results, first_exc = [], None
+        results, excs = [], []
         for fut in futures:
             try:
                 results.append(fut.result())
             except BaseException as e:  # noqa: BLE001 — propagate any crash
-                if first_exc is None:
-                    first_exc = e
-        if first_exc is not None:
-            raise first_exc
+                excs.append(e)
+        if excs:
+            # Prefer an *interesting* exception: when one branch crashes
+            # for a real reason, sibling branches often die with barrier/
+            # interrupt noise that would mask the root cause (reference
+            # dom-top real-pmap-helper).
+            boring = (threading.BrokenBarrierError, InterruptedError)
+            raise next((e for e in excs if not isinstance(e, boring)),
+                       excs[0])
         return results
 
 

@@ -1,0 +1,283 @@
+"""End-to-end orchestrator tests, mirroring the reference's
+`jepsen/test/jepsen/core_test.clj`: a complete run (OS → DB → generator →
+history → checker) executes hermetically in-process against the dummy
+remote and the atom DB/client."""
+
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core, db as jdb, nemesis as jnemesis
+from jepsen_tpu import generator as gen
+from jepsen_tpu import os_ as jos
+from jepsen_tpu import store, testkit
+from jepsen_tpu.history import is_invoke, is_ok
+
+
+def noop_test(tmp_path, **kw):
+    t = testkit.noop_test()
+    t["ssh"] = {"dummy": True}
+    t["store-dir"] = str(tmp_path / "store")
+    t.update(kw)
+    return t
+
+
+class TrackingClient(jclient.Client):
+    """Tracks open connections in a shared set (core_test.clj:22-41)."""
+
+    _uid = [0]
+    _lock = threading.Lock()
+
+    def __init__(self, conns, uid=None):
+        self.conns = conns
+        self.uid = uid
+
+    def open(self, test, node):
+        with self._lock:
+            self._uid[0] += 1
+            uid = self._uid[0]
+        self.conns.add(uid)
+        return TrackingClient(self.conns, uid)
+
+    def invoke(self, test, op):
+        return {**op, "type": "ok"}
+
+    def close(self, test):
+        self.conns.discard(self.uid)
+
+
+def test_most_interesting_exception(tmp_path):
+    """DB setup crashes on one node; sibling nodes die with barrier
+    noise. The *interesting* exception must surface
+    (core_test.clj:43-60)."""
+
+    class DB(jdb.DB):
+        def setup(self, test, node):
+            if node == test["nodes"][2]:
+                raise RuntimeError("hi")
+            raise threading.BrokenBarrierError("oops")
+
+    t = noop_test(tmp_path, name="interesting exception", db=DB())
+    with pytest.raises(RuntimeError, match="^hi$"):
+        core.run(t)
+
+
+def test_basic_cas(tmp_path):
+    """1000 mixed read/write/cas ops at concurrency 10 against the atom
+    register; checks history shape and client/DB lifecycle bookkeeping
+    (core_test.clj:62-120)."""
+    state = testkit.AtomState()
+    n = 1000
+    rng = random.Random(45100)
+    t = noop_test(
+        tmp_path,
+        name="basic cas",
+        db=testkit.atom_db(state),
+        client=testkit.atom_client(state, latency_s=0.0),
+        concurrency=10,
+        generator=gen.phases(
+            {"f": "read"},
+            gen.clients(gen.limit(n, gen.reserve(
+                5, gen.repeat({"f": "read"}),
+                gen.mix([
+                    lambda: {"f": "write", "value": rng.randint(0, 4)},
+                    lambda: {"f": "cas",
+                             "value": [rng.randint(0, 4),
+                                       rng.randint(0, 4)]},
+                ]))))),
+    )
+    t = core.run(t)
+    h = t["history"]
+
+    # db teardown ran last
+    assert state.read() == "done"
+
+    # client lifecycle: n_nodes opens+setups first, then worker
+    # open/close churn, then n_nodes teardowns+closes
+    nn = len(t["nodes"])
+    log = state.meta_log
+    assert sorted(log[:2 * nn]) == ["open"] * nn + ["setup"] * nn
+    assert sorted(log[-2 * nn:]) == ["close"] * nn + ["teardown"] * nn
+    mid = log[2 * nn:-2 * nn]
+    assert mid.count("open") == mid.count("close")
+
+    assert t["results"]["valid?"] is True
+
+    oks = [o for o in h if is_ok(o)]
+    reads = [o for o in oks if o["f"] == "read"]
+    assert reads[0]["value"] == 0  # first read sees the fresh DB
+
+    assert len(h) == 2 * (n + 1)
+    assert {o["f"] for o in h} == {"read", "write", "cas"}
+    for o in h:
+        if is_invoke(o) and o["f"] == "read":
+            assert o.get("value") is None
+        elif o["f"] == "read" and is_ok(o):
+            assert 0 <= o["value"] <= 4
+        elif o["f"] == "write":
+            assert 0 <= o["value"] <= 4
+        elif o["f"] == "cas":
+            old, new = o["value"]
+            assert 0 <= old <= 4 and 0 <= new <= 4
+
+    # two-phase persistence landed
+    assert store.load_history(t) is not None
+    assert store.load_results(t)["valid?"] is True
+
+
+def test_dummy_remote_lifecycle(tmp_path):
+    """OS/DB setup+teardown and primary setup run over the (dummy)
+    control layer, once per node, with sessions bound
+    (core_test.clj:122-177, sans real SSH)."""
+    os_startups, os_teardowns = {}, {}
+    db_startups, db_teardowns = {}, {}
+    db_primaries = []
+
+    class OS(jos.OS):
+        def setup(self, test, node):
+            os_startups[node] = True
+
+        def teardown(self, test, node):
+            os_teardowns[node] = True
+
+    class DB(jdb.DB, jdb.Primary):
+        def setup(self, test, node):
+            db_startups[node] = True
+
+        def teardown(self, test, node):
+            db_teardowns[node] = True
+
+        def primaries(self, test):
+            return test["nodes"][:1]
+
+        def setup_primary(self, test, node):
+            db_primaries.append(node)
+
+    t = noop_test(tmp_path, name="dummy lifecycle", os=OS(), db=DB())
+    t = core.run(t)
+    assert t["results"]["valid?"] is True
+    nodes = set(t["nodes"])
+    assert set(os_startups) == set(os_teardowns) == nodes
+    assert set(db_startups) == set(db_teardowns) == nodes
+    assert db_primaries == [t["nodes"][0]]
+
+
+def test_worker_recovery(tmp_path):
+    """A client that always crashes consumes exactly n ops — crashed
+    processes are retired and replaced, not re-fed the same op forever
+    (core_test.clj:179-198)."""
+    invocations = [0]
+    n = 12
+
+    class Crasher(jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            invocations[0] += 1
+            return 1 // 0
+
+    core.run(noop_test(
+        tmp_path,
+        name="worker recovery",
+        client=Crasher(),
+        generator=gen.nemesis(None, gen.limit(n, gen.repeat({"f": "read"}))),
+    ))
+    assert invocations[0] == n
+
+
+def test_generator_recovery(tmp_path):
+    """A generator crash propagates without deadlocking workers parked
+    at a phase barrier, and all clients get closed
+    (core_test.clj:200-222)."""
+    conns = set()
+
+    def poison(test, ctx):
+        if list(ctx.free_threads) == [0]:
+            return 1 // 0
+        return {"type": "invoke", "f": "meow"}
+
+    t = noop_test(
+        tmp_path,
+        name="generator recovery",
+        client=TrackingClient(conns),
+        generator=gen.clients(gen.phases(
+            gen.each_thread(gen.once(poison)),
+            gen.once({"type": "invoke", "f": "done"}))),
+    )
+    with pytest.raises(gen.GenException) as ei:
+        core.run(t)
+    assert isinstance(ei.value.__cause__, ZeroDivisionError)
+    assert conns == set()
+
+
+@pytest.mark.parametrize("stage", ["open", "setup", "teardown", "close"])
+def test_client_error_rethrown(tmp_path, stage):
+    """Errors in client lifecycle hooks are rethrown from the run
+    (core_test.clj:224-249)."""
+
+    class C(jclient.Client):
+        def open(self, test, node):
+            assert stage != "open"
+            return self
+
+        def setup(self, test):
+            assert stage != "setup"
+
+        def invoke(self, test, op):
+            return {**op, "type": "ok"}
+
+        def teardown(self, test):
+            assert stage != "teardown"
+
+        def close(self, test):
+            assert stage != "close"
+
+    with pytest.raises(AssertionError):
+        core.run(noop_test(tmp_path, client=C()))
+
+
+@pytest.mark.parametrize("stage", ["setup", "teardown"])
+def test_nemesis_error_rethrown(tmp_path, stage):
+    class N(jnemesis.Nemesis):
+        def setup(self, test):
+            assert stage != "setup"
+            return self
+
+        def invoke(self, test, op):
+            return op
+
+        def teardown(self, test):
+            assert stage != "teardown"
+
+    with pytest.raises(AssertionError):
+        core.run(noop_test(tmp_path, nemesis=N()))
+
+
+def test_synchronize_barrier(tmp_path):
+    """DB setup threads can rendezvous via core.synchronize
+    (core.clj:44-57)."""
+    order = []
+
+    class DB(jdb.DB):
+        def setup(self, test, node):
+            order.append(("pre", node))
+            core.synchronize(test)
+            order.append(("post", node))
+
+    t = noop_test(tmp_path, db=DB())
+    core.run(t)
+    pres = [i for i, (ph, _) in enumerate(order) if ph == "pre"]
+    posts = [i for i, (ph, _) in enumerate(order) if ph == "post"]
+    assert max(pres) < min(posts)
+
+
+def test_prepare_test_defaults():
+    t = core.prepare_test({"nodes": ["a", "b"]})
+    assert t["concurrency"] == 2
+    assert isinstance(t["barrier"], threading.Barrier)
+    assert t["start-time"]
+    t0 = core.prepare_test({"nodes": []})
+    assert t0["barrier"] == core.NO_BARRIER
