@@ -139,12 +139,18 @@ from .basic import (  # noqa: E402
     counter, log_file_pattern, queue, set_checker, set_full, stats,
     total_queue, unhandled_exceptions, unique_ids,
 )
+from .clock import clock_plot  # noqa: E402
 from .linear import linearizable  # noqa: E402
+# `perf_checker` (not `perf`) so the factory doesn't shadow the
+# jepsen_tpu.checker.perf submodule attribute.
+from .perf import latency_graph, perf_checker  # noqa: E402
+from .perf import rate_graph_checker as rate_graph  # noqa: E402
 
 __all__ = [
     "Checker", "UNKNOWN", "merge_valid", "check_safe", "compose",
     "concurrency_limit", "noop", "unbridled_optimism", "coerce",
     "stats", "unhandled_exceptions", "set_checker", "set_full", "queue",
     "total_queue", "unique_ids", "counter", "log_file_pattern",
-    "linearizable",
+    "linearizable", "latency_graph", "rate_graph", "perf_checker",
+    "clock_plot",
 ]

@@ -1,0 +1,269 @@
+"""Tests for the rendering layer: perf graphs, HTML timeline, clock
+plots, and the SVG plot library — mirroring the reference's
+perf_test.clj approach of rendering from synthetic histories, plus
+structural assertions on the emitted artifacts."""
+
+import math
+import os
+
+import pytest
+
+import importlib
+
+import jepsen_tpu.checker.clock as cclock
+import jepsen_tpu.checker.timeline as timeline
+from jepsen_tpu import plot as gp
+
+# `checker.perf` the attribute is the composed-checker factory
+# (reference-parity API); the module itself lives in sys.modules.
+perf = importlib.import_module("jepsen_tpu.checker.perf")
+from jepsen_tpu import store, util
+from jepsen_tpu.checker import clock_plot, latency_graph, rate_graph
+from jepsen_tpu.history import history
+
+
+def synth_history(n=200, procs=4, dt_ns=25_000_000):
+    """A deterministic invoke/complete history with some fails/infos and
+    a nemesis start/stop window."""
+    ops, t = [], 0
+    for i in range(n):
+        p = i % procs
+        f = ("read", "write", "cas")[i % 3]
+        t += dt_ns
+        ops.append({"type": "invoke", "f": f, "process": p, "time": t,
+                    "value": None})
+        typ = ("ok", "ok", "ok", "fail", "info")[i % 5]
+        ops.append({"type": typ, "f": f, "process": p,
+                    "time": t + dt_ns // 2, "value": i % 5})
+    mid = ops[len(ops) // 2]["time"]
+    ops += [
+        {"type": "invoke", "f": "start", "process": "nemesis",
+         "time": mid, "value": None},
+        {"type": "info", "f": "start", "process": "nemesis",
+         "time": mid + dt_ns, "value": "partitioned"},
+        {"type": "invoke", "f": "stop", "process": "nemesis",
+         "time": mid + 20 * dt_ns, "value": None},
+        {"type": "info", "f": "stop", "process": "nemesis",
+         "time": mid + 21 * dt_ns, "value": "healed"},
+    ]
+    ops.sort(key=lambda o: o["time"])
+    return history(ops).index()
+
+
+@pytest.fixture
+def test_map(tmp_path):
+    return {"name": "perf-test", "start-time": "t0",
+            "store-dir": str(tmp_path / "store")}
+
+
+# -- bucketing / quantiles (perf.clj:21-86 semantics) -----------------------
+
+def test_bucket_scale_midpoints():
+    assert perf.bucket_scale(10, 0) == 5
+    assert perf.bucket_scale(10, 1) == 15
+    assert perf.bucket_time(10, 7) == 5
+    assert perf.bucket_time(10, 13) == 15
+    assert perf.buckets(10, 30) == [5, 15, 25]
+
+
+def test_quantiles():
+    qs = perf.quantiles([0, 0.5, 1], [3, 1, 2, 4, 5])
+    assert qs == {0: 1, 0.5: 3, 1: 5}
+    assert perf.quantiles([0.5], []) == {}
+
+
+def test_latencies_to_quantiles():
+    pts = [(1, 10), (2, 20), (11, 100), (12, 300)]
+    out = perf.latencies_to_quantiles(10, [1.0], pts)
+    assert out == {1.0: [[5.0, 20], [15.0, 300]]}
+
+
+def test_invokes_by_f_type():
+    h = util.history_latencies(synth_history(20))
+    by = perf.invokes_by_f_type(h)
+    assert {"read", "write", "cas"} <= set(by)
+    for f in by:
+        for t in ("ok", "fail", "info"):
+            for o in by[f][t]:
+                assert o["completion"]["type"] == t
+
+
+def test_rate_totals():
+    h = synth_history(30)
+    r = perf.rate(h)
+    total = r["all"]["all"]
+    assert total == sum(v for f, m in r.items() if f != "all"
+                       for t, v in m.items() if t != "all")
+
+
+# -- nemesis activity -------------------------------------------------------
+
+def test_nemesis_activity_intervals():
+    h = synth_history(100)
+    acts = perf.nemesis_activity(None, h)
+    assert len(acts) == 1
+    n = acts[0]
+    assert n["name"] == "nemesis"
+    assert len(n["ops"]) == 4
+    assert len(n["intervals"]) == 2  # invoke-pair + completion-pair
+    for a, b in n["intervals"]:
+        assert a["f"] == "start" and b["f"] == "stop"
+
+
+def test_named_nemesis_spec():
+    h = synth_history(100)
+    acts = perf.nemesis_activity(
+        [{"name": "partitions", "start": ["start"], "stop": ["stop"],
+          "color": "#ff0000"}], h)
+    assert [a["name"] for a in acts] == ["partitions"]
+
+
+# -- SVG plot library -------------------------------------------------------
+
+def test_broaden_range():
+    lo, hi = gp.broaden_range((0.3, 9.7))
+    assert lo <= 0.3 and hi >= 9.7
+    assert gp.broaden_range((5, 5)) == (4, 6)
+
+
+def test_render_basic_svg():
+    p = gp.Plot(title="t", ylabel="y")
+    p.series.append(gp.Series(title="s1", data=[(0, 1), (1, 2), (2, 4)],
+                              mode="linespoints"))
+    svg = gp.render(p)
+    assert svg.startswith("<svg")
+    assert "s1" in svg and "</svg>" in svg
+
+
+def test_render_log_scale():
+    p = gp.Plot(logscale_y=True)
+    p.series.append(gp.Series(title=None,
+                              data=[(0, 0.1), (1, 10), (2, 1000)]))
+    svg = gp.render(p)
+    assert "<svg" in svg
+
+
+def test_no_points():
+    p = gp.Plot()
+    p.series.append(gp.Series(title="empty", data=[]))
+    with pytest.raises(gp.NoPoints):
+        gp.render(p)
+    assert gp.write(p, "/nonexistent/should-not-write.svg") is None
+
+
+# -- graph checkers end to end ----------------------------------------------
+
+def test_point_and_quantile_graphs(test_map):
+    h = synth_history(300)
+    res = latency_graph().check(test_map, h, {})
+    assert res["valid?"] is True
+    raw = store.path(test_map, "latency-raw.svg")
+    q = store.path(test_map, "latency-quantiles.svg")
+    assert os.path.exists(raw) and os.path.exists(q)
+    svg = open(raw).read()
+    # nemesis shading + all three completion types present
+    assert "opacity" in svg
+    assert "read ok" in svg and "cas fail" in svg and "write info" in svg
+
+
+def test_rate_graph(test_map):
+    h = synth_history(300)
+    res = rate_graph().check(test_map, h, {})
+    assert res["valid?"] is True
+    svg = open(store.path(test_map, "rate.svg")).read()
+    assert "Throughput" in svg
+
+
+def test_perf_compose(test_map):
+    res = perf.perf_checker().check(test_map, synth_history(300), {})
+    assert res["valid?"] is True
+    for f in ("latency-raw.svg", "latency-quantiles.svg", "rate.svg"):
+        assert os.path.exists(store.path(test_map, f))
+
+
+def test_graphs_subdirectory(test_map):
+    latency_graph().check(test_map, synth_history(60),
+                          {"subdirectory": "k1"})
+    assert os.path.exists(store.path(test_map, "k1", "latency-raw.svg"))
+
+
+def test_empty_history_graphs(test_map):
+    assert latency_graph().check(test_map, history([]), {})["valid?"] \
+        is True
+    assert rate_graph().check(test_map, history([]), {})["valid?"] is True
+
+
+# -- timeline ---------------------------------------------------------------
+
+def test_timeline_pairs():
+    h = [{"type": "invoke", "f": "r", "process": 0, "time": 1},
+         {"type": "ok", "f": "r", "process": 0, "time": 2},
+         {"type": "invoke", "f": "w", "process": 1, "time": 3},
+         {"type": "info", "f": "w", "process": 1, "time": 4},
+         {"type": "info", "f": "kill", "process": "nemesis", "time": 5}]
+    ps = timeline.pairs(h)
+    assert [len(p) for p in ps] == [2, 2, 1]
+    assert ps[2][0]["f"] == "kill"
+
+
+def test_timeline_html(test_map):
+    h = synth_history(100)
+    res = timeline.html().check(test_map, h, {})
+    assert res["valid?"] is True
+    doc = open(store.path(test_map, "timeline.html")).read()
+    assert "<html>" in doc
+    assert 'class="op ok"' in doc and 'class="op fail"' in doc
+    assert "Showing only" not in doc  # under the cap
+
+
+def test_timeline_truncation(test_map, monkeypatch):
+    monkeypatch.setattr(timeline, "OP_LIMIT", 10)
+    h = synth_history(100)
+    timeline.html().check(test_map, h, {})
+    doc = open(store.path(test_map, "timeline.html")).read()
+    assert "Showing only 10" in doc
+
+
+def test_timeline_process_index():
+    h = [{"process": 3}, {"process": "nemesis"}, {"process": 1},
+         {"process": 3}]
+    idx = timeline.process_index(h)
+    assert idx[1] == 0 and idx[3] == 1 and idx["nemesis"] == 2
+
+
+# -- clock plots ------------------------------------------------------------
+
+def test_clock_datasets():
+    h = [{"type": "info", "f": "check-offsets", "process": "nemesis",
+          "time": util.secs_to_nanos(1),
+          "clock-offsets": {"n1": 0.5, "n2": -0.25}},
+         {"type": "info", "f": "check-offsets", "process": "nemesis",
+          "time": util.secs_to_nanos(5),
+          "clock-offsets": {"n1": 1.5}},
+         {"type": "ok", "f": "read", "process": 0,
+          "time": util.secs_to_nanos(9)}]
+    ds = cclock.history_to_datasets(h)
+    assert ds["n1"] == [[1.0, 0.5], [5.0, 1.5], [9.0, 1.5]]
+    assert ds["n2"] == [[1.0, -0.25], [9.0, -0.25]]
+
+
+def test_short_node_names():
+    assert cclock.short_node_names(
+        ["n1.foo.com", "n2.foo.com"]) == ["n1", "n2"]
+    assert cclock.short_node_names(["a", "b"]) == ["a", "b"]
+
+
+def test_clock_plot_checker(test_map):
+    h = history([
+        {"type": "info", "f": "check-offsets", "process": "nemesis",
+         "time": util.secs_to_nanos(i),
+         "clock-offsets": {"n1": math.sin(i), "n2": 0.1 * i}}
+        for i in range(1, 20)])
+    res = clock_plot().check(test_map, h, {})
+    assert res["valid?"] is True
+    svg = open(store.path(test_map, "clock-skew.svg")).read()
+    assert "clock skew" in svg and "n1" in svg
+
+
+def test_clock_plot_empty(test_map):
+    assert clock_plot().check(test_map, history([]), {})["valid?"] is True
