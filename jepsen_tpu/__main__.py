@@ -1,0 +1,6 @@
+"""`python -m jepsen_tpu` — the default main: the store web server
+(reference `jepsen/src/jepsen/cli.clj:520-523`)."""
+
+from .cli import main
+
+main()

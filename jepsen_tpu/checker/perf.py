@@ -81,13 +81,6 @@ def latencies_to_quantiles(dt: float, qs, points) -> dict:
 
 # -- history splitting (`perf.clj:87-148`) ----------------------------------
 
-def first_time(hist) -> Optional[float]:
-    for o in hist:
-        if o.get("time") is not None:
-            return util.nanos_to_secs(o["time"])
-    return None
-
-
 def invokes_by_type(ops) -> dict:
     """Split invocations by their completion's type."""
     return {t: [o for o in ops
@@ -299,6 +292,7 @@ def rate_graph(test, hist, opts=None, dt: float = 10) -> Optional[str]:
         d[b] = d.get(b, 0) + 1.0 / dt
     fs = polysort(datasets.keys())
     shapes = fs_to_points(fs)
+    bs = buckets(dt, t_max)
     p = gp.Plot(title=f"{test.get('name', '')} rate",
                 ylabel="Throughput (hz)")
     for f in fs:
@@ -307,7 +301,7 @@ def rate_graph(test, hist, opts=None, dt: float = 10) -> Optional[str]:
             if m:
                 p.series.append(gp.Series(
                     title=f"{f} {t}",
-                    data=[(b, m.get(b, 0)) for b in buckets(dt, t_max)],
+                    data=[(b, m.get(b, 0)) for b in bs],
                     color=TYPE_COLORS[t], mode="linespoints",
                     point_type=shapes[f]))
     with_nemeses(p, hist, _nemeses(test, opts))

@@ -90,7 +90,7 @@ def title(test, op, start, stop) -> str:
 
 def body(op, start, stop) -> str:
     same = stop is not None and start.get("value") == stop.get("value")
-    s = f"{op.get('process')} {op.get('f')} "
+    s = escape(f"{op.get('process')} {op.get('f')}") + " "
     if not is_nemesis(op):
         s += escape(repr(start.get("value")))
     if stop is not None and not same:

@@ -1,0 +1,385 @@
+"""Command line interface: a default main for common functions (the web
+interface) and utilities for test suites to build their own runners.
+
+Reference: `jepsen/src/jepsen/cli.clj` — the shared test option spec
+(:64-111), option post-processing (ssh-map renaming, node-list merging,
+`3n` concurrency parsing, :143-254), the `test`/`analyze` commands
+(:355-430), `test-all` (:432-518), `serve` (:336-353), and the runner's
+exit-code contract (:127-139):
+
+  0     all tests passed
+  1     some test failed
+  2     some test had unknown validity
+  254   invalid arguments
+  255   internal error
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pprint as _pprint
+import re
+import sys
+import time as _time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+TEST_USAGE = """Usage: PROG COMMAND [OPTIONS ...]
+
+Runs a test and exits with a status code:
+
+  0     All tests passed
+  1     Some test failed
+  2     Some test had an :unknown validity
+  254   Invalid arguments
+  255   Internal error
+"""
+
+
+def one_of(coll) -> str:
+    ks = coll.keys() if isinstance(coll, dict) else coll
+    return "Must be one of " + ", ".join(sorted(str(k) for k in ks))
+
+
+# -- option specs -----------------------------------------------------------
+#
+# An opt-spec is a list of dicts: {'long': '--name', 'short': '-n', plus
+# argparse kwargs}. Suites extend the shared spec; merge_opt_specs
+# resolves collisions by long name, preferring the latter (the
+# reference's merge-opt-specs, cli.clj:52-59).
+
+def opt(long: str, short: Optional[str] = None, **kw) -> dict:
+    return {"long": long, "short": short, **kw}
+
+
+def merge_opt_specs(a: list, b: list) -> list:
+    merged: dict = {}
+    for o in list(a) + list(b or []):
+        merged[o["long"]] = o
+    return list(merged.values())
+
+
+def _comma_list(s: str) -> list[str]:
+    return re.split(r",\s*", s)
+
+
+def test_opt_spec() -> list[dict]:
+    """Shared options for testing (`cli.clj:64-111`)."""
+    return [
+        # default=None, not DEFAULT_NODES: argparse's append mutates a
+        # list default in place; parse_nodes applies the default when no
+        # node options were given (reference repeated-opt, cli.clj:27-39)
+        opt("--node", "-n", action="append", metavar="HOSTNAME",
+            help="Node(s) to run test on; repeat for multiple nodes."),
+        opt("--nodes", metavar="NODE_LIST", type=_comma_list,
+            help="Comma-separated list of node hostnames."),
+        opt("--nodes-file", metavar="FILENAME",
+            help="File containing node hostnames, one per line."),
+        opt("--username", default="root", help="Username for logins"),
+        opt("--password", default="root", help="Password for sudo access"),
+        opt("--strict-host-key-checking", action="store_true",
+            help="Whether to check host keys"),
+        opt("--no-ssh", action="store_true",
+            help="Don't establish SSH connections to any nodes."),
+        opt("--ssh-private-key", metavar="FILE",
+            help="Path to an SSH identity file"),
+        opt("--concurrency", default="1n", metavar="NUMBER",
+            help="How many workers to run: an integer, optionally "
+                 "followed by n (e.g. 3n) to multiply by node count."),
+        opt("--leave-db-running", action="store_true",
+            help="Leave the database running at the end of the test."),
+        opt("--logging-json", action="store_true",
+            help="Use JSON structured output in the log."),
+        opt("--test-count", type=int, default=1, metavar="NUMBER",
+            help="How many times to repeat the test"),
+        opt("--time-limit", type=int, default=60, metavar="SECONDS",
+            help="How long the test should run, excluding setup/"
+                 "teardown, in seconds"),
+        opt("--store-dir", default="store", metavar="DIR",
+            help="Directory to store test results under"),
+    ]
+
+
+def tarball_opt(default: str) -> dict:
+    """--tarball URL option (`cli.clj:113-125`)."""
+    return opt("--tarball", metavar="URL", default=default,
+               help="URL for the DB package to install (file://, "
+                    "http://, or https://, ending .tar/.tgz/.zip).")
+
+
+class _Parser(argparse.ArgumentParser):
+    """argparse, but invalid arguments exit 254 (`cli.clj:324-326`)."""
+
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        print(f"{self.prog}: error: {message}", file=sys.stderr)
+        raise SystemExit(254)
+
+
+def build_parser(prog: str, spec: list[dict]) -> _Parser:
+    p = _Parser(prog=prog)
+    for o in spec:
+        args = [s for s in (o.get("short"), o["long"]) if s]
+        kw = {k: v for k, v in o.items() if k not in ("short", "long")}
+        p.add_argument(*args, **kw)
+    return p
+
+
+# -- option post-processing (`cli.clj:150-254`) -----------------------------
+
+def parse_concurrency(opts: dict, key: str = "concurrency") -> dict:
+    """'3n' -> 3 * node count; plain integers pass through."""
+    c = str(opts[key])
+    m = re.fullmatch(r"(\d+)(n?)", c)
+    if not m:
+        raise ValueError(f"--{key} {c} should be an integer optionally "
+                         "followed by n")
+    unit = len(opts["nodes"]) if m.group(2) == "n" else 1
+    opts[key] = int(m.group(1)) * unit
+    return opts
+
+
+def parse_nodes(opts: dict) -> dict:
+    """Merge --node / --nodes / --nodes-file into opts['nodes']
+    (`cli.clj:170-205`)."""
+    node = opts.pop("node", None)
+    nodes = opts.pop("nodes", None)
+    nodes_file = opts.pop("nodes_file", None)
+    if node is None and not (nodes or nodes_file):
+        node = list(DEFAULT_NODES)
+    from_file = []
+    if nodes_file:
+        with open(nodes_file) as f:
+            from_file = [ln.strip() for ln in f if ln.strip()]
+    opts["nodes"] = list(from_file) + list(nodes or []) + list(node or [])
+    return opts
+
+
+def rename_ssh_options(opts: dict) -> dict:
+    """Move SSH options under opts['ssh'] (`cli.clj:223-242`)."""
+    opts["ssh"] = {
+        "dummy": bool(opts.pop("no_ssh", False)),
+        "username": opts.pop("username", "root"),
+        "password": opts.pop("password", "root"),
+        "strict-host-key-checking":
+            bool(opts.pop("strict_host_key_checking", False)),
+        "private-key-path": opts.pop("ssh_private_key", None),
+    }
+    return opts
+
+
+def test_opt_fn(opts: dict) -> dict:
+    """The standard option pipeline (`cli.clj:245-254`)."""
+    opts = rename_ssh_options(opts)
+    opts["leave-db-running?"] = bool(opts.pop("leave_db_running", False))
+    opts["logging"] = {"json?": bool(opts.pop("logging_json", False))}
+    opts["store-dir"] = opts.pop("store_dir", "store")
+    parse_nodes(opts)
+    parse_concurrency(opts)
+    return opts
+
+
+# -- runner -----------------------------------------------------------------
+
+def run(subcommands: dict, argv: Optional[list[str]] = None) -> None:
+    """Parse argv and dispatch to a subcommand spec: a dict with
+    'opt_spec' (list), 'opt_fn', 'usage', and 'run' (fn(options dict))
+    (`cli.clj:258-334`). Exits via SystemExit with the documented
+    codes."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    command = argv[0] if argv else None
+    try:
+        if command not in subcommands:
+            print("Usage: PROG COMMAND [OPTIONS ...]")
+            print("Commands:", ", ".join(sorted(subcommands)))
+            raise SystemExit(254)
+        spec = subcommands[command]
+        parser = build_parser(command, spec.get("opt_spec") or [])
+        if spec.get("usage"):
+            parser.usage = spec["usage"]
+        opts = vars(parser.parse_args(argv[1:]))
+        opts["argv"] = argv
+        opt_fn = spec.get("opt_fn")
+        if opt_fn:
+            opts = opt_fn(opts)
+        runner = spec.get("run") or (lambda o: _pprint.pprint(o))
+        runner(opts)
+        raise SystemExit(0)
+    except SystemExit:
+        raise
+    except Exception:
+        log.critical("Oh jeez, I'm sorry, Jepsen broke. Here's why:",
+                     exc_info=True)
+        raise SystemExit(255)
+
+
+def _exit_for_validity(valid) -> Optional[int]:
+    from .checker import UNKNOWN
+    if valid is False:
+        return 1
+    if valid == UNKNOWN:
+        return 2
+    return None
+
+
+def single_test_cmd(opts: dict) -> dict:
+    """Builds the `test` and `analyze` commands around a test_fn
+    (`cli.clj:355-430`). Options: opt_spec (extra spec entries),
+    opt_fn (composed after test_opt_fn), opt_fn_ (replaces it),
+    tarball (default URL), usage, test_fn."""
+    from . import core
+
+    spec = merge_opt_specs(test_opt_spec(), opts.get("opt_spec") or [])
+    if opts.get("tarball"):
+        spec = merge_opt_specs(spec, [tarball_opt(opts["tarball"])])
+    opt_fn = test_opt_fn
+    if opts.get("opt_fn"):
+        f = opts["opt_fn"]
+        opt_fn = (lambda base: lambda o: f(base(o)))(opt_fn)
+    opt_fn = opts.get("opt_fn_") or opt_fn
+    test_fn = opts["test_fn"]
+    usage = opts.get("usage") or TEST_USAGE
+
+    def run_test(options):
+        log.info("Test options:\n%s", _pprint.pformat(options))
+        for _ in range(options.get("test-count",
+                                   options.get("test_count", 1))):
+            test = core.run(test_fn(options))
+            code = _exit_for_validity(
+                (test.get("results") or {}).get("valid?"))
+            if code is not None:
+                raise SystemExit(code)
+
+    def run_analyze(options):
+        from . import store
+        log.info("Test options:\n%s", _pprint.pformat(options))
+        cli_test = test_fn(options)
+        latest = store.latest(cli_test.get("store-dir", "store"))
+        if latest is None:
+            raise RuntimeError("Not sure what the last test was")
+        stored = store.load_test(latest)
+        if stored.get("name") != cli_test.get("name"):
+            raise RuntimeError(
+                f"Stored test ({stored.get('name')}) and CLI test "
+                f"({cli_test.get('name')}) have different names; aborting")
+        stored.pop("results", None)
+        test = {**cli_test, **stored}
+        core.analyze(test)
+
+    return {
+        "test": {"opt_spec": spec, "opt_fn": opt_fn, "usage": usage,
+                 "run": run_test},
+        "analyze": {"opt_spec": spec, "opt_fn": opt_fn, "usage": usage,
+                    "run": run_analyze},
+    }
+
+
+def test_all_run_tests(tests) -> dict:
+    """Run tests, returning {outcome: [store paths]} where outcome is
+    True/False/'unknown'/'crashed' (`cli.clj:432-448`)."""
+    from . import core, store
+    out: dict = {}
+    for test in tests:
+        test = core.prepare_test(test)
+        try:
+            done = core.run(test)
+            key = (done.get("results") or {}).get("valid?")
+        except Exception:
+            log.warning("Test crashed", exc_info=True)
+            key = "crashed"
+        out.setdefault(key, []).append(store.dir_name(test))
+    return out
+
+
+def test_all_print_summary(results: dict) -> dict:
+    """(`cli.clj:450-478`)"""
+    from .checker import UNKNOWN
+    print("\n")
+    for key, heading in ((True, "Successful tests"),
+                         (UNKNOWN, "Indeterminate tests"),
+                         ("crashed", "Crashed tests"),
+                         (False, "Failed tests")):
+        if results.get(key):
+            print(f"\n# {heading}\n")
+            for path in results[key]:
+                print(path)
+    print()
+    print(len(results.get(True, [])), "successes")
+    print(len(results.get(UNKNOWN, [])), "unknown")
+    print(len(results.get("crashed", [])), "crashed")
+    print(len(results.get(False, [])), "failures")
+    return results
+
+
+def test_all_exit(results: dict) -> None:
+    """255 if any crashed, 2 if unknown, 1 if invalid, else 0
+    (`cli.clj:480-488`)."""
+    from .checker import UNKNOWN
+    if results.get("crashed"):
+        raise SystemExit(255)
+    if results.get(UNKNOWN):
+        raise SystemExit(2)
+    if results.get(False):
+        raise SystemExit(1)
+    raise SystemExit(0)
+
+
+def test_all_cmd(opts: dict) -> dict:
+    """The `test-all` command around a tests_fn producing a sequence of
+    tests (`cli.clj:490-518`)."""
+    spec = merge_opt_specs(test_opt_spec(), opts.get("opt_spec") or [])
+    opt_fn = test_opt_fn
+    if opts.get("opt_fn"):
+        f = opts["opt_fn"]
+        opt_fn = (lambda base: lambda o: f(base(o)))(opt_fn)
+    opt_fn = opts.get("opt_fn_") or opt_fn
+    tests_fn = opts["tests_fn"]
+
+    def run_all(options):
+        log.info("CLI options:\n%s", _pprint.pformat(options))
+        test_all_exit(test_all_print_summary(
+            test_all_run_tests(tests_fn(options))))
+
+    return {"test-all": {"opt_spec": spec, "opt_fn": opt_fn,
+                         "usage": "Runs all tests", "run": run_all}}
+
+
+def serve_cmd() -> dict:
+    """The `serve` web-server command (`cli.clj:336-353`)."""
+    def run_serve(options):
+        from . import web
+        server = web.serve(options)
+        log.info("Listening on http://%s:%s/",
+                 options.get("host"), server.server_address[1])
+        print(f"Listening on http://{options.get('host')}:"
+              f"{server.server_address[1]}/")
+        try:
+            while True:
+                _time.sleep(1)
+        except KeyboardInterrupt:
+            server.shutdown()
+
+    return {"serve": {
+        "opt_spec": [
+            opt("--host", "-b", default="0.0.0.0",
+                help="Hostname to bind to"),
+            opt("--port", "-p", type=int, default=8080,
+                help="Port number to bind to"),
+            opt("--store-dir", default="store", metavar="DIR",
+                help="Store directory to serve"),
+        ],
+        "run": run_serve,
+    }}
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    run(serve_cmd(), argv)
+
+
+if __name__ == "__main__":
+    main()

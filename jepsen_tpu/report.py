@@ -1,0 +1,37 @@
+"""Reporting helper: redirect prints into a store file.
+
+Reference: `jepsen/src/jepsen/report.clj` — the `to` macro captures
+stdout to a file while still teeing to the console (:7-16)."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import sys
+
+
+class _Tee(io.TextIOBase):
+    def __init__(self, *sinks):
+        self.sinks = sinks
+
+    def write(self, s):
+        for sink in self.sinks:
+            sink.write(s)
+        return len(s)
+
+    def flush(self):
+        for sink in self.sinks:
+            sink.flush()
+
+
+@contextlib.contextmanager
+def to(filename: str, tee: bool = True):
+    """Context manager: stdout inside the block is written to filename
+    (and still echoed when tee=True) — the reference's `report/to`."""
+    with open(filename, "w") as f:
+        old = sys.stdout
+        sys.stdout = _Tee(f, old) if tee else f
+        try:
+            yield f
+        finally:
+            sys.stdout = old

@@ -93,10 +93,14 @@ def write_history(test, hist: Iterable[dict]) -> str:
     return p
 
 
-def load_history(test) -> History:
-    p = path(test, "history.jsonl.gz")
+def read_history(p: str) -> History:
+    """Parse a history.jsonl.gz file."""
     with gzip.open(p, "rt") as fh:
         return history(json.loads(line) for line in fh if line.strip())
+
+
+def load_history(test) -> History:
+    return read_history(path(test, "history.jsonl.gz"))
 
 
 def write_results(test, results: dict) -> str:
@@ -241,3 +245,22 @@ def stop_logging() -> None:
     while _saved_levels:
         name, level = _saved_levels.pop()
         logging.getLogger(name).setLevel(level)
+
+
+def load_test(d: str) -> dict:
+    """Reconstruct a test map (with history and, when present, results)
+    from a run directory — the post-hoc analysis path (reference
+    store/load, store.clj:193-250)."""
+    with open(os.path.join(d, "test.json")) as fh:
+        test = json.load(fh)
+    hist_path = os.path.join(d, "history.jsonl.gz")
+    if os.path.exists(hist_path):
+        # save_1 runs pre-analysis, so the stored history carries no
+        # 'index' fields; index here so index-dependent consumers
+        # (timeline anchors, linearizability reports) work post-hoc
+        test["history"] = read_history(hist_path).index()
+    res_path = os.path.join(d, "results.json")
+    if os.path.exists(res_path):
+        with open(res_path) as fh:
+            test["results"] = json.load(fh)
+    return test

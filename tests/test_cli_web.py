@@ -1,0 +1,260 @@
+"""CLI + web UI tests: option parsing/post-processing parity with
+cli.clj, end-to-end `test` command runs over the dummy remote, exit
+codes, and the store browser."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import checker, cli, core, generator as gen
+from jepsen_tpu import repl, report, store, testkit, web
+
+
+# -- option post-processing -------------------------------------------------
+
+def parse(argv, extra_spec=None):
+    p = cli.build_parser("test",
+                         cli.merge_opt_specs(cli.test_opt_spec(),
+                                             extra_spec or []))
+    return vars(p.parse_args(argv))
+
+
+def test_defaults():
+    o = cli.test_opt_fn(parse([]))
+    assert o["nodes"] == cli.DEFAULT_NODES
+    assert o["concurrency"] == 5  # 1n * 5 nodes
+    assert o["ssh"]["dummy"] is False
+    assert o["ssh"]["username"] == "root"
+    assert o["time_limit"] == 60
+    assert o["test_count"] == 1
+
+
+def test_concurrency_multiplier():
+    o = cli.test_opt_fn(parse(["--concurrency", "3n"]))
+    assert o["concurrency"] == 15
+    o = cli.test_opt_fn(parse(["--concurrency", "7"]))
+    assert o["concurrency"] == 7
+    with pytest.raises(ValueError):
+        cli.test_opt_fn(parse(["--concurrency", "x3"]))
+
+
+def test_node_flags_override_default():
+    o = cli.test_opt_fn(parse(["-n", "a", "-n", "b"]))
+    # repeated -n extends argparse's default list; the post-processing
+    # must drop the default when explicit nodes were given
+    assert o["nodes"] == ["a", "b"]
+
+
+def test_nodes_list():
+    o = cli.test_opt_fn(parse(["--nodes", "a,b, c"]))
+    assert o["nodes"] == ["a", "b", "c"]
+
+
+def test_nodes_file(tmp_path):
+    f = tmp_path / "nodes"
+    f.write_text("x1\nx2\n\nx3\n")
+    o = cli.test_opt_fn(parse(["--nodes-file", str(f)]))
+    assert o["nodes"] == ["x1", "x2", "x3"]
+
+
+def test_ssh_opts():
+    o = cli.test_opt_fn(parse(["--no-ssh", "--username", "admin",
+                               "--ssh-private-key", "/k"]))
+    assert o["ssh"] == {"dummy": True, "username": "admin",
+                       "password": "root",
+                       "strict-host-key-checking": False,
+                       "private-key-path": "/k"}
+
+
+def test_merge_opt_specs_prefers_latter():
+    spec = cli.merge_opt_specs(cli.test_opt_spec(),
+                               [cli.opt("--time-limit", type=int,
+                                        default=10)])
+    p = cli.build_parser("t", spec)
+    assert vars(p.parse_args([]))["time_limit"] == 10
+
+
+def test_invalid_args_exit_254():
+    with pytest.raises(SystemExit) as e:
+        cli.run({"test": {"opt_spec": cli.test_opt_spec()}},
+                ["test", "--bogus-flag"])
+    assert e.value.code == 254
+
+
+def test_unknown_command_exits_254(capsys):
+    with pytest.raises(SystemExit) as e:
+        cli.run({"test": {}}, ["wat"])
+    assert e.value.code == 254
+    assert "Commands:" in capsys.readouterr().out
+
+
+def test_internal_error_exits_255():
+    def boom(opts):
+        raise RuntimeError("nope")
+    with pytest.raises(SystemExit) as e:
+        cli.run({"test": {"opt_spec": [], "run": boom}}, ["test"])
+    assert e.value.code == 255
+
+
+# -- single_test_cmd end to end ---------------------------------------------
+
+def make_test_fn(tmp_path, valid=True, state_box=None):
+    def test_fn(opts):
+        state = testkit.AtomState()
+        if state_box is not None:
+            state_box.append(state)
+        chk = checker.unbridled_optimism() if valid else \
+            (lambda test, hist, o: {"valid?": False})
+        return {
+            **{k: v for k, v in opts.items()
+               if k in ("nodes", "concurrency", "ssh", "store-dir",
+                        "leave-db-running?", "logging")},
+            "name": "cli-test",
+            "store-dir": str(tmp_path / "store"),
+            "db": testkit.atom_db(state),
+            "client": testkit.atom_client(state, latency_s=0.0),
+            "checker": chk,
+            "generator": gen.clients(
+                gen.limit(20, gen.repeat({"f": "read"}))),
+        }
+    return test_fn
+
+
+def test_single_test_cmd_ok(tmp_path):
+    cmds = cli.single_test_cmd({"test_fn": make_test_fn(tmp_path)})
+    assert set(cmds) == {"test", "analyze"}
+    with pytest.raises(SystemExit) as e:
+        cli.run(cmds, ["test", "--no-ssh", "--concurrency", "2"])
+    assert e.value.code == 0
+    assert os.path.isdir(tmp_path / "store" / "cli-test")
+
+
+def test_single_test_cmd_invalid_exits_1(tmp_path):
+    cmds = cli.single_test_cmd({"test_fn": make_test_fn(tmp_path,
+                                                        valid=False)})
+    with pytest.raises(SystemExit) as e:
+        cli.run(cmds, ["test", "--no-ssh"])
+    assert e.value.code == 1
+
+
+def test_analyze_command(tmp_path):
+    test_fn = make_test_fn(tmp_path)
+    cmds = cli.single_test_cmd({"test_fn": test_fn})
+    with pytest.raises(SystemExit):
+        cli.run(cmds, ["test", "--no-ssh"])
+    # analyze re-checks the stored history without re-running
+    with pytest.raises(SystemExit) as e:
+        cli.run(cmds, ["analyze", "--no-ssh"])
+    assert e.value.code == 0
+
+
+def test_test_all_cmd(tmp_path):
+    test_fn = make_test_fn(tmp_path)
+
+    def tests_fn(opts):
+        return [test_fn(opts), test_fn(opts)]
+
+    cmds = cli.test_all_cmd({"tests_fn": tests_fn})
+    with pytest.raises(SystemExit) as e:
+        cli.run(cmds, ["test-all", "--no-ssh"])
+    assert e.value.code == 0
+
+
+def test_test_all_failure_code(tmp_path):
+    ok_fn = make_test_fn(tmp_path)
+    bad_fn = make_test_fn(tmp_path, valid=False)
+
+    cmds = cli.test_all_cmd(
+        {"tests_fn": lambda o: [ok_fn(o), bad_fn(o)]})
+    with pytest.raises(SystemExit) as e:
+        cli.run(cmds, ["test-all", "--no-ssh"])
+    assert e.value.code == 1
+
+
+# -- web UI -----------------------------------------------------------------
+
+@pytest.fixture
+def populated_store(tmp_path):
+    test_fn = make_test_fn(tmp_path)
+    cmds = cli.single_test_cmd({"test_fn": test_fn})
+    with pytest.raises(SystemExit):
+        cli.run(cmds, ["test", "--no-ssh"])
+    return str(tmp_path / "store")
+
+
+def test_home_page(populated_store):
+    page = web.home_page(populated_store)
+    assert "cli-test" in page
+    assert web.COLORS["ok"] in page  # valid run renders blue
+
+
+def test_valid_colors():
+    assert web.valid_color(True) == web.COLORS["ok"]
+    assert web.valid_color(False) == web.COLORS["fail"]
+    assert web.valid_color("unknown") == web.COLORS["info"]
+    assert web.valid_color("incomplete") == web.COLORS[None]
+
+
+def test_web_server_end_to_end(populated_store):
+    server = web.serve({"host": "127.0.0.1", "port": 0,
+                        "store-dir": populated_store})
+    port = server.server_address[1]
+    try:
+        def get(path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}") as r:
+                    return (r.status, r.headers.get("Content-Type"),
+                            r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, e.headers.get("Content-Type"), b""
+
+        status, ctype, body = get("/")
+        assert status == 200 and b"cli-test" in body
+
+        status, ctype, body = get("/files/cli-test")
+        assert status == 200 and b"latest" in body
+
+        runs = [d for d in os.listdir(
+            os.path.join(populated_store, "cli-test"))
+            if not d.startswith("latest")]
+        run = runs[0]
+        status, ctype, body = get(f"/files/cli-test/{run}/results.json")
+        assert status == 200
+        assert json.loads(body)["valid?"] is True
+
+        status, ctype, body = get(f"/files/cli-test/{run}/jepsen.log")
+        assert ctype == "text/plain"
+
+        status, ctype, body = get(f"/files/cli-test/{run}.zip")
+        assert status == 200 and ctype == "application/zip"
+        assert body[:2] == b"PK"
+
+        # path traversal is refused
+        status, _, _ = get("/files/..%2f..%2fetc")
+        assert status in (403, 404)
+    finally:
+        server.shutdown()
+
+
+# -- report / repl ----------------------------------------------------------
+
+def test_report_to(tmp_path, capsys):
+    p = str(tmp_path / "out.txt")
+    with report.to(p):
+        print("hello report")
+    assert "hello report" in open(p).read()
+    assert "hello report" in capsys.readouterr().out
+
+
+def test_repl_latest(populated_store):
+    t = repl.latest_test(populated_store)
+    assert t["name"] == "cli-test"
+    assert len(t["history"]) == 40
+    assert t["results"]["valid?"] is True
+    # post-hoc re-analysis with a different checker
+    re = repl.recheck(dict(t, **{"store-dir": populated_store}),
+                      checker.stats())
+    assert re["results"]["valid?"] is True
