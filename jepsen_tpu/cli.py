@@ -178,6 +178,10 @@ def test_opt_fn(opts: dict) -> dict:
     opts["leave-db-running?"] = bool(opts.pop("leave_db_running", False))
     opts["logging"] = {"json?": bool(opts.pop("logging_json", False))}
     opts["store-dir"] = opts.pop("store_dir", "store")
+    if "time_limit" in opts:
+        opts["time-limit"] = opts.pop("time_limit")
+    if "test_count" in opts:
+        opts["test-count"] = opts.pop("test_count")
     parse_nodes(opts)
     parse_concurrency(opts)
     return opts
@@ -205,7 +209,13 @@ def run(subcommands: dict, argv: Optional[list[str]] = None) -> None:
         opts["argv"] = argv
         opt_fn = spec.get("opt_fn")
         if opt_fn:
-            opts = opt_fn(opts)
+            try:
+                opts = opt_fn(opts)
+            except (ValueError, OSError) as e:
+                # option post-processing failures are user errors, not
+                # internal crashes: report and exit 254 per the contract
+                print(e, file=sys.stderr)
+                raise SystemExit(254)
         runner = spec.get("run") or (lambda o: _pprint.pprint(o))
         runner(opts)
         raise SystemExit(0)
@@ -226,6 +236,16 @@ def _exit_for_validity(valid) -> Optional[int]:
     return None
 
 
+def _resolve_opt_fn(opts: dict):
+    """Compose the standard pipeline with a suite's opt_fn, or replace
+    it entirely via opt_fn_ (`cli.clj:381-387`)."""
+    opt_fn = test_opt_fn
+    if opts.get("opt_fn"):
+        f = opts["opt_fn"]
+        opt_fn = (lambda base: lambda o: f(base(o)))(opt_fn)
+    return opts.get("opt_fn_") or opt_fn
+
+
 def single_test_cmd(opts: dict) -> dict:
     """Builds the `test` and `analyze` commands around a test_fn
     (`cli.clj:355-430`). Options: opt_spec (extra spec entries),
@@ -236,18 +256,13 @@ def single_test_cmd(opts: dict) -> dict:
     spec = merge_opt_specs(test_opt_spec(), opts.get("opt_spec") or [])
     if opts.get("tarball"):
         spec = merge_opt_specs(spec, [tarball_opt(opts["tarball"])])
-    opt_fn = test_opt_fn
-    if opts.get("opt_fn"):
-        f = opts["opt_fn"]
-        opt_fn = (lambda base: lambda o: f(base(o)))(opt_fn)
-    opt_fn = opts.get("opt_fn_") or opt_fn
+    opt_fn = _resolve_opt_fn(opts)
     test_fn = opts["test_fn"]
     usage = opts.get("usage") or TEST_USAGE
 
     def run_test(options):
         log.info("Test options:\n%s", _pprint.pformat(options))
-        for _ in range(options.get("test-count",
-                                   options.get("test_count", 1))):
+        for _ in range(options.get("test-count", 1)):
             test = core.run(test_fn(options))
             code = _exit_for_validity(
                 (test.get("results") or {}).get("valid?"))
@@ -332,11 +347,7 @@ def test_all_cmd(opts: dict) -> dict:
     """The `test-all` command around a tests_fn producing a sequence of
     tests (`cli.clj:490-518`)."""
     spec = merge_opt_specs(test_opt_spec(), opts.get("opt_spec") or [])
-    opt_fn = test_opt_fn
-    if opts.get("opt_fn"):
-        f = opts["opt_fn"]
-        opt_fn = (lambda base: lambda o: f(base(o)))(opt_fn)
-    opt_fn = opts.get("opt_fn_") or opt_fn
+    opt_fn = _resolve_opt_fn(opts)
     tests_fn = opts["tests_fn"]
 
     def run_all(options):
@@ -363,6 +374,10 @@ def serve_cmd() -> dict:
         except KeyboardInterrupt:
             server.shutdown()
 
+    def serve_opt_fn(o):
+        o["store-dir"] = o.pop("store_dir", "store")
+        return o
+
     return {"serve": {
         "opt_spec": [
             opt("--host", "-b", default="0.0.0.0",
@@ -372,6 +387,7 @@ def serve_cmd() -> dict:
             opt("--store-dir", default="store", metavar="DIR",
                 help="Store directory to serve"),
         ],
+        "opt_fn": serve_opt_fn,
         "run": run_serve,
     }}
 

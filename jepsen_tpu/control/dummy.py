@@ -40,18 +40,49 @@ class DummyRemote(Remote):
         return r
 
     def execute(self, context: dict, action: dict) -> dict:
+        cmd = action.get("cmd", "")
         with self._lock:
             self.log.append((self.host, dict(context or {}), dict(action)))
-        out = ""
         for pattern, resp in self.responses:
-            if re.search(pattern, action.get("cmd", "")):
+            if re.search(pattern, cmd):
                 if isinstance(resp, Callable):
                     extra = resp(context, action)
                     return {**action, "exit": 0, "out": "", "err": "",
                             **extra}
-                out = resp
-                break
-        return {**action, "exit": 0, "out": out, "err": ""}
+                return {**action, "exit": 0, "out": resp, "err": ""}
+        return {**action, **self._fake_fs(cmd), "err": ""}
+
+    def _fake_fs(self, cmd: str) -> dict:
+        """Minimal filesystem semantics over the shared ``files`` map,
+        so existence-polling helpers (exists/stat, tmp_dir, cached_wget)
+        terminate instead of seeing every path as present. Commands may
+        arrive wrapped (`cd /foo; stat x`); only the last segment
+        matters."""
+        # commands may be cd- and sudo-wrapped:
+        #   sudo -k -S -u root bash -c "cd /; stat /x"
+        tail = cmd.split(";")[-1].strip().rstrip("\"'")
+        m = re.fullmatch(r"(?:stat|test -[efd]) (\S+)", tail)
+        if m:
+            path = m.group(1)
+            with self._lock:
+                known = any(f == path or f.startswith(path + "/")
+                            for f in self.files)
+            return {"exit": 0 if known else 1, "out": ""}
+        m = re.fullmatch(r"(?:mkdir -p|touch) (\S+)", tail)
+        if m:
+            with self._lock:
+                self.files.setdefault(m.group(1), b"")
+            return {"exit": 0, "out": ""}
+        m = re.fullmatch(r"mv (\S+) (\S+)", tail)
+        if m:
+            src, dst = m.groups()
+            with self._lock:
+                if src in self.files:
+                    self.files[dst] = self.files.pop(src)
+                else:
+                    self.files.setdefault(dst, b"")
+            return {"exit": 0, "out": ""}
+        return {"exit": 0, "out": ""}
 
     def upload(self, context, local_paths, remote_path, opts=None):
         if isinstance(local_paths, (str, bytes)):

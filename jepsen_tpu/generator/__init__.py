@@ -183,6 +183,52 @@ class Gen:
         return self
 
 
+_UNPULLED = object()
+
+
+class IterGen(Gen):
+    """Lifts a Python iterator into a generator — the analog of the
+    reference's lazy-seq generators (`generator.clj:545-590` seqs),
+    enabling infinite op streams like the set workload's unique-add
+    sequence. The head pull is memoized so repeated op() calls on the
+    same value are idempotent; each emitted op hands back a fresh
+    wrapper around the shared iterator tail."""
+
+    def __init__(self, it):
+        self.it = it
+        self._head = _UNPULLED
+
+    def _pull(self):
+        if self._head is _UNPULLED:
+            try:
+                self._head = next(self.it)
+            except StopIteration:
+                self._head = None
+        return self._head
+
+    def op(self, test, ctx):
+        head = self._pull()
+        if head is None:
+            return None
+        res = op(head, test, ctx)
+        if res is None:
+            # an exhausted sub-generator head: move on to the tail
+            return op(IterGen(self.it), test, ctx)
+        o, g1 = res
+        if o is PENDING:
+            # memoize the (possibly wrapped/advanced) head so no pulled
+            # element is lost when the interpreter re-asks later
+            self._head = g1
+            return (o, self)
+        tail = IterGen(self.it)
+        return (o, [g1, tail] if g1 is not None else tail)
+
+    def update(self, test, ctx, event):
+        if self._head not in (_UNPULLED, None):
+            self._head = update(self._head, test, ctx, event)
+        return self
+
+
 def op(gen, test: dict, ctx: Context):
     """Ask any liftable generator for its next operation."""
     while True:
@@ -208,6 +254,9 @@ def op(gen, test: dict, ctx: Context):
             o, g1 = res
             rest = list(gen[1:])
             return (o, [g1] + rest if rest else g1)
+        if hasattr(gen, "__next__"):
+            gen = IterGen(gen)
+            continue
         raise TypeError(f"not a generator: {gen!r}")
 
 
@@ -223,6 +272,8 @@ def update(gen, test: dict, ctx: Context, event: dict):
         if not gen:
             return None
         return [update(gen[0], test, ctx, event)] + list(gen[1:])
+    if hasattr(gen, "__next__"):
+        return update(IterGen(gen), test, ctx, event)
     raise TypeError(f"not a generator: {gen!r}")
 
 

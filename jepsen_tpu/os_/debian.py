@@ -33,11 +33,16 @@ def setup_hostfile() -> None:
 
 
 def time_since_last_update() -> int:
-    """Seconds since the last apt-get update (`os/debian.clj:29-33`)."""
-    now = int(c.exec_("date", "+%s"))
-    then = int(c.exec_("stat", "-c", "%Y",
-                       "/var/cache/apt/pkgcache.bin", lit("||"),
-                       "echo", "0"))
+    """Seconds since the last apt-get update (`os/debian.clj:29-33`).
+    Unparsable output (e.g. a no-op dummy remote) reads as freshly
+    updated, so hermetic runs skip apt entirely."""
+    try:
+        now = int(c.exec_("date", "+%s"))
+        then = int(c.exec_("stat", "-c", "%Y",
+                           "/var/cache/apt/pkgcache.bin", lit("||"),
+                           "echo", "0"))
+    except ValueError:
+        return 0
     return now - then
 
 

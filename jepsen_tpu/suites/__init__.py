@@ -1,0 +1,19 @@
+"""Per-database test suites (the reference's L7 layer).
+
+Each suite module exposes `test_fn(opts) -> test-map`, a workload menu,
+and `main(argv)` wired through `jepsen_tpu.cli` — mirroring how every
+reference suite exposes `-main` via `jepsen.cli` (e.g.
+`zookeeper/src/jepsen/zookeeper.clj:131-137`)."""
+
+from __future__ import annotations
+
+import importlib
+
+SUITES = ("etcd", "zookeeper", "hazelcast")
+
+
+def suite(name: str):
+    """Load a suite module by name."""
+    if name not in SUITES:
+        raise ValueError(f"unknown suite {name!r}; known: {SUITES}")
+    return importlib.import_module(f".{name}", __name__)

@@ -59,14 +59,14 @@ def fast_tests(base: str) -> list[dict]:
     return out
 
 
-def _file_url(base: str, *components) -> str:
+def _file_url(*components) -> str:
     return url_encode_path_components(
         "/files/" + "/".join(str(c) for c in components if c != ""))
 
 
 def test_row(t: dict) -> str:
     r = t.get("results") or {}
-    u = _file_url("", t["name"], t["start-time"])
+    u = _file_url(t["name"], t["start-time"])
     valid = r.get("valid?")
     return (
         "<tr>"
@@ -99,7 +99,7 @@ def dir_listing(base: str, rel: str, full: str) -> str:
     items = []
     for name in sorted(os.listdir(full)):
         p = os.path.join(full, name)
-        u = _file_url("", *(rel.split("/") if rel else []), name)
+        u = _file_url(*(rel.split("/") if rel else []), name)
         if os.path.isdir(p):
             valid = None
             try:
@@ -114,11 +114,11 @@ def dir_listing(base: str, rel: str, full: str) -> str:
             size = os.path.getsize(p)
             items.append(f'<tr><td><a href="{u}">{html.escape(name)}</a> '
                          f"({size} bytes)</td></tr>")
-    up = _file_url("", *(rel.split("/")[:-1] if rel else []))
+    up = _file_url(*(rel.split("/")[:-1] if rel else []))
     return ("<html><body>"
             f'<h1>{html.escape("/" + rel)}</h1>'
             f'<p><a href="/">home</a> | <a href="{up}">up</a> | '
-            f'<a href="{_file_url("", rel).rstrip("/")}.zip">zip</a></p>'
+            f'<a href="{_file_url(rel).rstrip("/")}.zip">zip</a></p>'
             f"<table>{''.join(items)}</table></body></html>")
 
 

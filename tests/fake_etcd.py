@@ -1,0 +1,103 @@
+"""A minimal in-process etcd v3 JSON-gateway fake: /v3/kv/range, put,
+and txn (VALUE-EQUAL compares) over a lock-guarded dict. Lets the etcd
+suite run a complete hermetic test — real HTTP, real client code, no
+etcd binary."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _b64d(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def _b64e(s: str) -> str:
+    return base64.b64encode(str(s).encode()).decode()
+
+
+class FakeEtcd:
+    def __init__(self):
+        self.kv: dict[str, str] = {}
+        self.rev = 1
+        self.lock = threading.Lock()
+        self.server: ThreadingHTTPServer | None = None
+
+    # kv semantics ---------------------------------------------------------
+
+    def range(self, req: dict) -> dict:
+        key = _b64d(req["key"])
+        end = _b64d(req["range_end"]) if req.get("range_end") else None
+        with self.lock:
+            if end is None:
+                items = [(key, self.kv[key])] if key in self.kv else []
+            else:
+                items = sorted((k, v) for k, v in self.kv.items()
+                               if key <= k < end)
+        return {"header": {"revision": str(self.rev)},
+                "kvs": [{"key": _b64e(k), "value": _b64e(v)}
+                        for k, v in items],
+                "count": str(len(items))}
+
+    def put(self, req: dict) -> dict:
+        with self.lock:
+            self.kv[_b64d(req["key"])] = _b64d(req["value"])
+            self.rev += 1
+        return {"header": {"revision": str(self.rev)}}
+
+    def txn(self, req: dict) -> dict:
+        with self.lock:
+            ok = True
+            for cmp in req.get("compare") or []:
+                assert cmp.get("target") == "VALUE"
+                assert cmp.get("result") == "EQUAL"
+                k = _b64d(cmp["key"])
+                want = _b64d(cmp["value"])
+                if self.kv.get(k) != want:
+                    ok = False
+            branch = req.get("success" if ok else "failure") or []
+            for o in branch:
+                if "requestPut" in o:
+                    p = o["requestPut"]
+                    self.kv[_b64d(p["key"])] = _b64d(p["value"])
+                    self.rev += 1
+        return {"header": {"revision": str(self.rev)},
+                "succeeded": ok}
+
+    # http -----------------------------------------------------------------
+
+    def start(self) -> int:
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                route = {"/v3/kv/range": fake.range,
+                         "/v3/kv/put": fake.put,
+                         "/v3/kv/txn": fake.txn}.get(self.path)
+                if route is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(route(req)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        return self.server.server_address[1]
+
+    def stop(self):
+        if self.server:
+            self.server.shutdown()

@@ -1,0 +1,130 @@
+"""An in-process fake ZooKeeper speaking the real wire protocol
+(connect handshake + create/getData/setData/exists/delete), backed by a
+lock-guarded dict of path -> (data, version). Exercises the suite's
+jute client over actual TCP."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from jepsen_tpu.suites import zk_proto as z
+
+
+class FakeZk:
+    def __init__(self):
+        self.nodes: dict[str, tuple[bytes, int]] = {}
+        self.lock = threading.Lock()
+        self.zxid = 0
+        self.sessions = 0
+        self.server: socketserver.ThreadingTCPServer | None = None
+
+    def handle_op(self, op: int, r: z.Reader) -> tuple[int, bytes]:
+        """-> (err, payload)"""
+        with self.lock:
+            self.zxid += 1
+            if op == z.CREATE:
+                path = r.string()
+                data = r.buffer() or b""
+                if path in self.nodes:
+                    return z.NODEEXISTS, b""
+                self.nodes[path] = (data, 0)
+                return z.OK, z.enc_string(path)
+            if op == z.GET_DATA:
+                path = r.string()
+                if path not in self.nodes:
+                    return z.NONODE, b""
+                data, version = self.nodes[path]
+                return z.OK, z.enc_buffer(data) + self._stat(version,
+                                                             len(data))
+            if op == z.SET_DATA:
+                path = r.string()
+                data = r.buffer() or b""
+                want = r.int()
+                if path not in self.nodes:
+                    return z.NONODE, b""
+                _old, version = self.nodes[path]
+                if want != -1 and want != version:
+                    return z.BADVERSION, b""
+                self.nodes[path] = (data, version + 1)
+                return z.OK, self._stat(version + 1, len(data))
+            if op == z.EXISTS:
+                path = r.string()
+                if path not in self.nodes:
+                    return z.NONODE, b""
+                data, version = self.nodes[path]
+                return z.OK, self._stat(version, len(data))
+            if op == z.DELETE:
+                path = r.string()
+                self.nodes.pop(path, None)
+                return z.OK, b""
+            return z.OK, b""
+
+    def _stat(self, version: int, dlen: int) -> bytes:
+        return (z.enc_long(1) + z.enc_long(self.zxid) + z.enc_long(0)
+                + z.enc_long(0) + z.enc_int(version) + z.enc_int(0)
+                + z.enc_int(0) + z.enc_long(0) + z.enc_int(dlen)
+                + z.enc_int(0) + z.enc_long(self.zxid))
+
+    def start(self) -> int:
+        fake = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def _recv_n(self, n):
+                out = b""
+                while len(out) < n:
+                    chunk = self.request.recv(n - len(out))
+                    if not chunk:
+                        raise ConnectionError
+                    out += chunk
+                return out
+
+            def _frame(self):
+                (n,) = struct.unpack(">i", self._recv_n(4))
+                return self._recv_n(n)
+
+            def _send(self, payload):
+                self.request.sendall(struct.pack(">i", len(payload))
+                                     + payload)
+
+            def handle(self):
+                try:
+                    r = z.Reader(self._frame())      # ConnectRequest
+                    r.int(), r.long()
+                    timeout = r.int()
+                    with fake.lock:
+                        fake.sessions += 1
+                        sid = fake.sessions
+                    self._send(z.enc_int(0) + z.enc_int(timeout)
+                               + z.enc_long(sid)
+                               + z.enc_buffer(b"\x00" * 16))
+                    while True:
+                        r = z.Reader(self._frame())
+                        xid = r.int()
+                        op = r.int()
+                        if op == z.CLOSE:
+                            return
+                        if op == z.PING:
+                            self._send(z.enc_int(-2) + z.enc_long(0)
+                                       + z.enc_int(0))
+                            continue
+                        err, payload = fake.handle_op(op, r)
+                        self._send(z.enc_int(xid)
+                                   + z.enc_long(fake.zxid)
+                                   + z.enc_int(err) + payload)
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        return self.server.server_address[1]
+
+    def stop(self):
+        if self.server:
+            self.server.shutdown()

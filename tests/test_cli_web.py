@@ -27,8 +27,8 @@ def test_defaults():
     assert o["concurrency"] == 5  # 1n * 5 nodes
     assert o["ssh"]["dummy"] is False
     assert o["ssh"]["username"] == "root"
-    assert o["time_limit"] == 60
-    assert o["test_count"] == 1
+    assert o["time-limit"] == 60
+    assert o["test-count"] == 1
 
 
 def test_concurrency_multiplier():

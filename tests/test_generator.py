@@ -308,3 +308,28 @@ def test_friendly_exceptions_wraps():
         raise RuntimeError("nope")
     with pytest.raises(gen.GenException):
         sim.quick(gen.friendly_exceptions(boom))
+
+
+# -- Python iterators as generators (lazy-seq parity) ------------------------
+
+def test_iterator_generator():
+    """Python iterators lift to generators, like the reference's lazy
+    seqs — including infinite streams."""
+    import itertools
+    it = ({"type": "invoke", "f": "add", "value": i}
+          for i in itertools.count())
+    h = sim.quick(gen.limit(5, it))
+    assert [o["value"] for o in h] == [0, 1, 2, 3, 4]
+
+
+def test_iterator_generator_finite():
+    it = iter([{"type": "invoke", "f": "a", "value": None},
+               {"type": "invoke", "f": "b", "value": None}])
+    assert [o["f"] for o in sim.quick(it)] == ["a", "b"]
+
+
+def test_iterator_of_subgenerators():
+    """Iterator elements may themselves be generators."""
+    it = iter([gen.limit(2, gen.repeat({"f": "x"})),
+               gen.once({"f": "y"})])
+    assert [o["f"] for o in sim.quick(it)] == ["x", "x", "y"]

@@ -1,0 +1,133 @@
+"""etcd suite tests: DB command generation against the recording dummy
+remote, client semantics against an in-process fake etcd gateway, and a
+complete hermetic suite run (real HTTP, real checkers)."""
+
+import pytest
+
+from fake_etcd import FakeEtcd
+
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import etcd, suite
+
+
+@pytest.fixture
+def fake():
+    f = FakeEtcd()
+    f.port = f.start()
+    yield f
+    f.stop()
+
+
+def url_fn(fake):
+    return lambda node: f"http://127.0.0.1:{fake.port}"
+
+
+def test_suite_registry():
+    assert suite("etcd") is etcd
+
+
+def test_initial_cluster():
+    t = {"nodes": ["n1", "n2"]}
+    assert etcd.initial_cluster(t) == \
+        "n1=http://n1:2380,n2=http://n2:2380"
+
+
+def test_db_setup_commands():
+    """DB setup runs the install + daemon-start pipeline over the
+    control layer (tutorial 02-db.md)."""
+    log = []
+    # scripted ls so install_archive sees one extracted root dir
+    remote = dummy.remote(
+        log=log, responses={r"ls -A \.": "etcd-v3.5.9-linux-amd64"})
+    test = {"nodes": ["n1"], "tarball": "file:///tmp/etcd.tgz"}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            etcd.db().setup(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "start-stop-daemon" in cmds
+    assert "--initial-cluster n1=http://n1:2380" in cmds
+    assert "--data-dir /opt/etcd/data" in cmds
+    # teardown kills the daemon and wipes data
+    log.clear()
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            etcd.db().teardown(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "rm -rf /opt/etcd/data" in cmds
+
+
+def test_client_kv_roundtrip(fake):
+    c = etcd.EtcdClient(url=f"http://127.0.0.1:{fake.port}")
+    assert c.read("k") is None
+    c.write("k", 3)
+    assert c.read("k") == "3"
+    assert c.cas("k", 3, 4) is True
+    assert c.cas("k", 3, 5) is False
+    assert c.read("k") == "4"
+
+
+def test_client_invoke_register(fake):
+    c = etcd.EtcdClient(url=f"http://127.0.0.1:{fake.port}")
+    w = c.invoke({}, {"type": "invoke", "f": "write", "value": 2,
+                      "process": 0})
+    assert w["type"] == "ok"
+    r = c.invoke({}, {"type": "invoke", "f": "read", "value": None,
+                      "process": 0})
+    assert r["type"] == "ok" and r["value"] == 2
+    cas = c.invoke({}, {"type": "invoke", "f": "cas", "value": (2, 3),
+                        "process": 0})
+    assert cas["type"] == "ok"
+    cas2 = c.invoke({}, {"type": "invoke", "f": "cas", "value": (2, 4),
+                         "process": 0})
+    assert cas2["type"] == "fail"
+
+
+def test_client_errors_classified():
+    # nothing listening on this port: connection refused → definite fail
+    c = etcd.EtcdClient(timeout_s=0.2, url="http://127.0.0.1:1")
+    r = c.invoke({}, {"type": "invoke", "f": "read", "value": None,
+                      "process": 0})
+    assert r["type"] == "fail"
+    w = c.invoke({}, {"type": "invoke", "f": "write", "value": 1,
+                      "process": 0})
+    assert w["type"] in ("fail", "info")
+
+
+def test_etcd_test_map_builds():
+    t = etcd.etcd_test({"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                        "ssh": {"dummy": True}, "workload": "register",
+                        "time-limit": 5})
+    assert t["name"] == "etcd-register"
+    assert t["db"].version == etcd.DEFAULT_VERSION
+    assert t["generator"] is not None
+    assert t["concurrency"] == 6
+
+
+@pytest.mark.parametrize("workload", sorted(etcd.WORKLOADS))
+def test_hermetic_suite_run(tmp_path, fake, workload):
+    """The whole suite end to end: dummy remote for the cluster, fake
+    etcd over real HTTP for the data plane, full checker stack."""
+    opts = {
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 6,
+        "ssh": {"dummy": True},
+        "workload": workload,
+        "rate": 200,
+        "time-limit": 3,
+        "ops-per-key": 20,
+        "nemesis": "none",
+        "store-dir": str(tmp_path / "store"),
+    }
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    t = etcd.etcd_test(opts)
+    t["db"] = jepsen_tpu.db.noop    # no real cluster
+    t["os"] = jepsen_tpu.os_.noop
+    t["client-url-fn"] = url_fn(fake)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert len(done["history"]) > 10

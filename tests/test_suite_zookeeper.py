@@ -1,0 +1,110 @@
+"""ZooKeeper suite tests: zoo.cfg generation, DB commands over the
+dummy remote, the jute wire client against an in-process fake ZK over
+real TCP, and a complete hermetic suite run."""
+
+import pytest
+
+from fake_zk import FakeZk
+
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import zk_proto, zookeeper
+
+
+@pytest.fixture
+def fake():
+    f = FakeZk()
+    f.port = f.start()
+    yield f
+    f.stop()
+
+
+def test_node_ids():
+    t = {"nodes": ["a", "b", "c"]}
+    assert zookeeper.zk_node_ids(t) == {"a": 0, "b": 1, "c": 2}
+    assert zookeeper.zoo_cfg_servers(t) == \
+        "server.0=a:2888:3888\nserver.1=b:2888:3888\nserver.2=c:2888:3888"
+
+
+def test_db_setup_commands():
+    log = []
+    remote = dummy.remote(log=log)
+    test = {"nodes": ["n1", "n2"]}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            zookeeper.db().setup(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "/etc/zookeeper/conf/myid" in cmds
+    assert "/etc/zookeeper/conf/zoo.cfg" in cmds
+    assert "service zookeeper restart" in cmds
+    assert "server.0=n1:2888:3888" in cmds
+
+
+def test_wire_client_roundtrip(fake):
+    c = zk_proto.ZooKeeper("127.0.0.1", fake.port, timeout=2)
+    assert c.session_id > 0
+    assert c.exists("/jepsen") is None
+    c.create("/jepsen", b"0")
+    data, stat = c.get_data("/jepsen")
+    assert data == b"0" and stat.version == 0
+    c.set_data("/jepsen", b"3", 0)
+    data, stat = c.get_data("/jepsen")
+    assert data == b"3" and stat.version == 1
+    # stale-version CAS fails with BADVERSION
+    with pytest.raises(zk_proto.ZkError) as e:
+        c.set_data("/jepsen", b"9", 0)
+    assert e.value.code == zk_proto.BADVERSION
+    c.close()
+
+
+def test_client_register_semantics(fake):
+    t = {"zk-port": fake.port, "zk-host-fn": lambda n: "127.0.0.1"}
+    c = zookeeper.ZkClient().open(t, "n1")
+    c.setup(t)
+    assert c.invoke(t, {"f": "read", "process": 0})["value"] == 0
+    assert c.invoke(t, {"f": "write", "value": 4,
+                        "process": 0})["type"] == "ok"
+    assert c.invoke(t, {"f": "cas", "value": [4, 2],
+                        "process": 0})["type"] == "ok"
+    assert c.invoke(t, {"f": "cas", "value": [4, 1],
+                        "process": 0})["type"] == "fail"
+    assert c.invoke(t, {"f": "read", "process": 0})["value"] == 2
+    c.close(t)
+
+
+def test_client_connection_errors():
+    t = {"zk-port": 1, "zk-host-fn": lambda n: "127.0.0.1"}
+    with pytest.raises(OSError):
+        zookeeper.ZkClient(timeout_s=0.2).open(t, "n1")
+
+
+def test_zk_test_map():
+    t = zookeeper.zk_test({"nodes": ["n1"], "concurrency": 2,
+                           "ssh": {"dummy": True}})
+    assert t["name"] == "zookeeper"
+    assert t["generator"] is not None
+
+
+def test_hermetic_suite_run(tmp_path, fake):
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    t = zookeeper.zk_test({
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 3,
+        "ssh": {"dummy": True},
+        "time-limit": 3,
+        "store-dir": str(tmp_path / "store"),
+    })
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["nemesis"] = __import__("jepsen_tpu").nemesis.noop
+    t["zk-port"] = fake.port
+    t["zk-host-fn"] = lambda n: "127.0.0.1"
+    # speed the clock up: 3s wall with 1s stagger is plenty
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, {k: v.get("valid?")
+                                   for k, v in res.items()
+                                   if isinstance(v, dict)}
+    assert len(done["history"]) > 2
