@@ -262,7 +262,10 @@ def single_test_cmd(opts: dict) -> dict:
 
     def run_test(options):
         log.info("Test options:\n%s", _pprint.pformat(options))
-        for _ in range(options.get("test-count", 1)):
+        # test_count fallback: an opt_fn_ override replaces the pipeline
+        # that remaps argparse's test_count to test-count
+        for _ in range(options.get("test-count",
+                                   options.get("test_count", 1))):
             test = core.run(test_fn(options))
             code = _exit_for_validity(
                 (test.get("results") or {}).get("valid?"))

@@ -207,21 +207,25 @@ class IterGen(Gen):
         return self._head
 
     def op(self, test, ctx):
-        head = self._pull()
-        if head is None:
-            return None
-        res = op(head, test, ctx)
-        if res is None:
-            # an exhausted sub-generator head: move on to the tail
-            return op(IterGen(self.it), test, ctx)
-        o, g1 = res
-        if o is PENDING:
-            # memoize the (possibly wrapped/advanced) head so no pulled
-            # element is lost when the interpreter re-asks later
-            self._head = g1
-            return (o, self)
-        tail = IterGen(self.it)
-        return (o, [g1, tail] if g1 is not None else tail)
+        while True:
+            head = self._pull()
+            if head is None:
+                return None
+            res = op(head, test, ctx)
+            if res is None:
+                # an exhausted sub-generator head: re-pull for the next
+                # element (iteratively — a long run of empty heads must
+                # not recurse)
+                self._head = _UNPULLED
+                continue
+            o, g1 = res
+            if o is PENDING:
+                # memoize the (possibly wrapped/advanced) head so no
+                # pulled element is lost when the interpreter re-asks
+                self._head = g1
+                return (o, self)
+            tail = IterGen(self.it)
+            return (o, [g1, tail] if g1 is not None else tail)
 
     def update(self, test, ctx, event):
         if self._head not in (_UNPULLED, None):

@@ -95,17 +95,26 @@ def uninstall(pkg_or_pkgs) -> None:
 
 
 def install(pkg_or_pkgs, force: bool = False) -> None:
-    """Install packages unless already present (`os/debian.clj:81-103`)."""
-    pkgs = pkg_or_pkgs if isinstance(pkg_or_pkgs, (list, tuple, set)) \
-        else [pkg_or_pkgs]
-    pkgs = [str(p) for p in pkgs]
-    missing = pkgs if force else sorted(set(pkgs) - installed(pkgs))
+    """Install packages unless already present. Accepts a name, a
+    collection of names, or a dict of name -> pinned version — the
+    reference's map form, rendered as apt's pkg=version syntax
+    (`os/debian.clj:81-103`)."""
+    if isinstance(pkg_or_pkgs, dict):
+        versions = {str(k): str(v) for k, v in pkg_or_pkgs.items()}
+    elif isinstance(pkg_or_pkgs, (list, tuple, set)):
+        versions = {str(p): None for p in pkg_or_pkgs}
+    else:
+        versions = {str(pkg_or_pkgs): None}
+    names = sorted(versions)
+    missing = names if force else sorted(set(names) - installed(names))
     if not missing:
         return
     maybe_update()
+    specs = [p if versions[p] is None else f"{p}={versions[p]}"
+             for p in missing]
     with c.su():
         c.exec_("env", lit("DEBIAN_FRONTEND=noninteractive"),
-                "apt-get", "install", "-y", *missing)
+                "apt-get", "install", "-y", *specs)
 
 
 def add_repo(repo_name: str, apt_line: str,

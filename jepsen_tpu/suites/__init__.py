@@ -8,6 +8,8 @@ reference suite exposes `-main` via `jepsen.cli` (e.g.
 from __future__ import annotations
 
 import importlib
+import json
+import urllib.request
 
 SUITES = ("etcd", "zookeeper", "hazelcast")
 
@@ -17,3 +19,13 @@ def suite(name: str):
     if name not in SUITES:
         raise ValueError(f"unknown suite {name!r}; known: {SUITES}")
     return importlib.import_module(f".{name}", __name__)
+
+
+def http_post(url: str, body: dict, timeout: float = 5.0) -> dict:
+    """POST a JSON body, parse a JSON response — the shared transport
+    for HTTP-spoken data planes (etcd's v3 gateway, the CP shim)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())

@@ -26,6 +26,7 @@ from .. import independent, nemesis as jnemesis, testkit
 from ..checker import timeline
 from ..control import util as cu
 from ..nemesis import partition
+from . import http_post
 from ..os_ import debian
 from ..workloads import linearizable_register
 
@@ -151,14 +152,6 @@ def b64(s) -> str:
 
 def unb64(s: str) -> str:
     return base64.b64decode(s).decode()
-
-
-def http_post(url: str, body: dict, timeout: float = 5.0) -> dict:
-    req = urllib.request.Request(
-        url, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.loads(r.read())
 
 
 class EtcdClient(jclient.Client):

@@ -215,3 +215,21 @@ class TestFaketime:
         cmds = [a.get("cmd", "") for _, _, a in r.log]
         assert any("mv /opt/db/bin/db.no-faketime /opt/db/bin/db" in c0
                    for c0 in cmds)
+
+
+def test_debian_install_versions():
+    """install() accepts a dict of package -> pinned version, rendered
+    as apt's pkg=version syntax (os/debian.clj:81-103 map form)."""
+    from jepsen_tpu import control
+    from jepsen_tpu.control import dummy
+    from jepsen_tpu.os_ import debian
+
+    log = []
+    remote = dummy.remote(log=log)
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            debian.install({"zookeeper": "3.4.13", "zookeeperd": "3.4.13"})
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "zookeeper=3.4.13" in cmds
+    assert "zookeeperd=3.4.13" in cmds

@@ -1,0 +1,102 @@
+"""Hazelcast-style CP-menu suite tests: the shim's primitives, each
+workload client, the suite-local semaphore checker, and hermetic runs
+of every menu entry against the in-process shim."""
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.suites import cp_shim, hazelcast
+
+
+@pytest.fixture
+def shim():
+    server, port = cp_shim.serve()
+    yield server, port
+    server.shutdown()
+
+
+def url_fn(port):
+    return lambda node: f"http://127.0.0.1:{port}"
+
+
+def test_shim_lock_semantics(shim):
+    server, port = shim
+    c = hazelcast.http_post
+    u = f"http://127.0.0.1:{port}"
+    assert c(u + "/lock/acquire", {"name": "l", "owner": "a"})["ok"]
+    assert not c(u + "/lock/acquire", {"name": "l", "owner": "b"})["ok"]
+    assert not c(u + "/lock/release", {"name": "l", "owner": "b"})["ok"]
+    assert c(u + "/lock/release", {"name": "l", "owner": "a"})["ok"]
+    assert c(u + "/lock/acquire", {"name": "l", "owner": "b"})["ok"]
+
+
+def test_shim_semaphore(shim):
+    _server, port = shim
+    c = hazelcast.http_post
+    u = f"http://127.0.0.1:{port}"
+    assert c(u + "/semaphore/acquire",
+             {"name": "s", "owner": "a", "permits": 2})["ok"]
+    assert c(u + "/semaphore/acquire",
+             {"name": "s", "owner": "b", "permits": 2})["ok"]
+    assert not c(u + "/semaphore/acquire",
+                 {"name": "s", "owner": "c", "permits": 2})["ok"]
+    assert c(u + "/semaphore/release", {"name": "s", "owner": "a"})["ok"]
+    assert c(u + "/semaphore/acquire",
+             {"name": "s", "owner": "c", "permits": 2})["ok"]
+
+
+def test_shim_ids_and_queue(shim):
+    _server, port = shim
+    c = hazelcast.http_post
+    u = f"http://127.0.0.1:{port}"
+    ids = {c(u + "/id", {})["value"] for _ in range(10)}
+    assert len(ids) == 10
+    c(u + "/queue/offer", {"name": "q", "value": 1})
+    c(u + "/queue/offer", {"name": "q", "value": 2})
+    assert c(u + "/queue/poll", {"name": "q"})["value"] == 1
+    assert c(u + "/queue/poll", {"name": "q"})["value"] == 2
+    assert c(u + "/queue/poll", {"name": "q"})["value"] is None
+
+
+def test_semaphore_checker():
+    ok = [{"type": "ok", "f": "acquire", "process": 0},
+          {"type": "ok", "f": "acquire", "process": 1},
+          {"type": "ok", "f": "release", "process": 0},
+          {"type": "ok", "f": "acquire", "process": 2}]
+    assert hazelcast.SemaphoreChecker(2).check({}, ok, {})["valid?"]
+    bad = ok[:2] + [{"type": "ok", "f": "acquire", "process": 3}]
+    res = hazelcast.SemaphoreChecker(2).check({}, bad, {})
+    assert res["valid?"] is False
+    assert res["over-capacity"]
+
+
+def test_menu_names():
+    assert set(hazelcast.WORKLOADS) == \
+        {"lock", "semaphore", "cas-register", "unique-ids", "queue"}
+
+
+@pytest.mark.parametrize("workload", sorted(hazelcast.WORKLOADS))
+def test_hermetic_menu_run(tmp_path, shim, workload):
+    import jepsen_tpu.db
+    import jepsen_tpu.nemesis
+    import jepsen_tpu.os_
+    _server, port = shim
+    t = hazelcast.hazelcast_test({
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 3,
+        "ssh": {"dummy": True},
+        "workload": workload,
+        "rate": 100,
+        "time-limit": 2,
+        "nemesis": "none",
+        "store-dir": str(tmp_path / "store"),
+    })
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["shim-url-fn"] = url_fn(port)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, {k: v.get("valid?")
+                                   for k, v in res.items()
+                                   if isinstance(v, dict)}
+    assert len(done["history"]) > 10
