@@ -28,11 +28,11 @@ def main() -> int:
     from jepsen_tpu.checker.wgl import analysis_tpu
 
     hist = synth.register_history(N_OPS, concurrency=CONCURRENCY, values=5,
-                                  crash_rate=0.002, seed=45100)
+                                  crash_rate=0.0005, seed=45100)
     model = models.cas_register()
 
     # First call compiles (~20-40 s on TPU); benchmark the steady state.
-    a = analysis_tpu(model, hist)
+    a = analysis_tpu(model, hist, budget_s=420)
     assert a["valid?"] is True, f"benchmark history must verify: {a}"
 
     best = float("inf")

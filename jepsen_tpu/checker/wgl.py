@@ -44,6 +44,7 @@ reduces verdicts with a psum-OR over ICI.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import heapq
@@ -154,6 +155,25 @@ class Entries:
         return cls(z, z, z, z, z, z, 0).pad_to(e)
 
 
+def required_slots(ops: OpArray) -> int:
+    """The peak number of simultaneously-pending ops (crashed ops pend
+    forever) — the minimum slot count the kernel needs. Computing it up
+    front avoids SlotOverflow escalation recompiles."""
+    # same (position, order) tie-break as build_entries: invokes sort
+    # before returns at equal positions
+    events = []
+    for r in range(len(ops)):
+        events.append((int(ops.inv[r]), 0, 1))
+        if ops.kind[r] == KIND_OK:
+            events.append((int(ops.ret[r]), 1, -1))
+    events.sort()
+    cur = peak = 0
+    for _, _, d in events:
+        cur += d
+        peak = max(peak, cur)
+    return max(peak, 1)
+
+
 def build_entries(ops: OpArray, p: int) -> Entries:
     """Lower an OpArray to an event stream, assigning each op a slot in
     [0, p). Raises SlotOverflow if concurrency + crashed ops exceed p."""
@@ -208,6 +228,11 @@ def _bucket(n: int, lo: int = 64) -> int:
 # ---------------------------------------------------------------------------
 # The kernel
 # ---------------------------------------------------------------------------
+
+Kernel = collections.namedtuple(
+    "Kernel", ["check", "check_batch", "check_chunk", "init_carry",
+               "summarize"])
+
 
 @functools.lru_cache(maxsize=32)
 def _kernel(model_name: str, F: int, P: int, E: int):
@@ -311,7 +336,27 @@ def _kernel(model_name: str, F: int, P: int, E: int):
                          jnp.bool_(False)))
         return masks, states, valid, overflow
 
-    def make_check(ek, es, ef, ea, eb, n_entries, init_state):
+    def init_carry(init_state):
+        masks0 = jnp.zeros((F, W), u32)
+        states0 = jnp.full((F,), init_state, i32)
+        valid0 = jnp.zeros((F,), jnp.bool_).at[0].set(True)
+        return (i32(0), masks0, states0, valid0,
+                jnp.zeros((P,), i32), jnp.full((P,), NIL, i32),
+                jnp.full((P,), NIL, i32), jnp.zeros((P,), jnp.bool_),
+                jnp.bool_(False), i32(1), i32(1))
+
+    def summarize(carry):
+        (e, _m, _s, _valid, *_slots, overflow, count, max_count) = carry
+        ok = count > 0
+        death = jnp.where(ok, i32(-1), e - 1)
+        return ok, death, overflow, max_count
+
+    def run_range(ek, es, ef, ea, eb, stop, carry):
+        """Advance the search from carry's position up to entry `stop`
+        (or until the frontier dies). Bounded-duration device work: long
+        histories run as a sequence of these calls with the frontier
+        carried between them — which is also the checkpoint for
+        long searches (the carry round-trips through host memory)."""
         def invoke_entry(e, masks, states, valid, slot_f, slot_a, slot_b,
                          slot_occ, overflow):
             s, f, a, b = es[e], ef[e], ea[e], eb[e]
@@ -354,7 +399,7 @@ def _kernel(model_name: str, F: int, P: int, E: int):
             return c
 
         def cond(c):
-            return (c[0] < n_entries) & (c[9] > 0)
+            return (c[0] < stop) & (c[9] > 0)
 
         def body(c):
             (e, masks, states, valid, slot_f, slot_a, slot_b, slot_occ,
@@ -373,18 +418,11 @@ def _kernel(model_name: str, F: int, P: int, E: int):
                     slot_occ, overflow, count,
                     jnp.maximum(max_count, count))
 
-        masks0 = jnp.zeros((F, W), u32)
-        states0 = jnp.full((F,), init_state, i32)
-        valid0 = jnp.zeros((F,), jnp.bool_).at[0].set(True)
-        carry = (i32(0), masks0, states0, valid0,
-                 jnp.zeros((P,), i32), jnp.full((P,), NIL, i32),
-                 jnp.full((P,), NIL, i32), jnp.zeros((P,), jnp.bool_),
-                 jnp.bool_(False), i32(1), i32(1))
-        (e, _, _, valid, *_rest, overflow, count, max_count) = \
-            lax.while_loop(cond, body, carry)
-        ok = count > 0
-        death = jnp.where(ok, i32(-1), e - 1)
-        return ok, death, overflow, max_count
+        return lax.while_loop(cond, body, carry)
+
+    def make_check(ek, es, ef, ea, eb, n_entries, init_state):
+        return summarize(run_range(ek, es, ef, ea, eb, n_entries,
+                                   init_carry(init_state)))
 
     @jax.jit
     def check(ek, es, ef, ea, eb, n_entries, init_state):
@@ -395,7 +433,11 @@ def _kernel(model_name: str, F: int, P: int, E: int):
         return jax.vmap(make_check)(ek, es, ef, ea, eb, n_entries,
                                     init_state)
 
-    return check, check_batch
+    @jax.jit
+    def check_chunk(ek, es, ef, ea, eb, stop, carry):
+        return run_range(ek, es, ef, ea, eb, stop, carry)
+
+    return Kernel(check, check_batch, check_chunk, init_carry, summarize)
 
 
 # ---------------------------------------------------------------------------
@@ -412,39 +454,68 @@ def encode_ops_for_model(model, hist) -> OpArray:
     return encode_ops(as_history(hist), codec, droppable)
 
 
-def analysis_tpu(model, hist, frontier: int = 1024, slots: int = 64,
-                 max_frontier: int = 65536) -> dict:
-    """Check one history on the device. Escalates the frontier size on
-    overflow-with-invalid (a dropped config could have been the witness);
-    falls back to the host search on slot overflow."""
+def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
+                 max_frontier: int = 65536,
+                 chunk_entries: int = 4096,
+                 budget_s: float | None = None) -> dict:
+    """Check one history on the device. The slot count is sized to the
+    history's actual peak concurrency; long histories run as a sequence
+    of bounded-duration chunked kernel calls with the frontier carried
+    (and checkpointable) between them, so a 100k-op search never holds
+    the device in one multi-minute call. Escalates the frontier on
+    overflow-with-invalid (a dropped config could have been the
+    witness); falls back to the host search past 256 slots.
+
+    budget_s caps total wall time: past it, an undecided search returns
+    'unknown' instead of escalating further (histories with many
+    crashed mutating ops are genuinely exponential — the reference's
+    checker hits the same wall as an OOM or its 1 h timeout)."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
     name = model.device_model
     ops = encode_ops_for_model(model, hist)
+    if slots is None:
+        slots = _bucket(required_slots(ops), lo=8)
     try:
         entries = build_entries(ops, slots)
     except SlotOverflow:
-        if slots < 256:
-            return analysis_tpu(model, hist, frontier, slots * 2,
-                                max_frontier)
+        # caller-supplied slots too small: size from the history
+        slots = _bucket(required_slots(ops), lo=8)
+        if slots <= 256:
+            entries = build_entries(ops, slots)
+    if slots > 256:
         from .linear import analysis_host
         a = analysis_host(model, hist)
         a["analyzer"] = "host-jit-linear (slot overflow)"
         return a
     E = _bucket(max(entries.n, 1))
     entries = entries.pad_to(E)
-    F = frontier
-    while True:
-        check, _ = _kernel(name, F, slots, E)
-        ok, death, overflow, max_count = check(
-            jnp.asarray(entries.kind), jnp.asarray(entries.slot),
+    args = (jnp.asarray(entries.kind), jnp.asarray(entries.slot),
             jnp.asarray(entries.f), jnp.asarray(entries.a),
-            jnp.asarray(entries.b), jnp.int32(entries.n),
-            jnp.int32(model.device_state()))
-        ok = bool(ok)
-        overflow = bool(overflow)
-        if ok or not overflow or F >= max_frontier:
+            jnp.asarray(entries.b))
+    F = frontier
+    timed_out = False
+    while True:
+        k = _kernel(name, F, slots, E)
+        carry = k.init_carry(jnp.int32(model.device_state()))
+        e = 0
+        while e < entries.n:
+            stop = min(e + chunk_entries, entries.n)
+            carry = k.check_chunk(*args, jnp.int32(stop), carry)
+            e = stop
+            if int(carry[-2]) == 0:   # frontier died: definite verdict
+                break
+            # only give up when chunks remain — a search that just
+            # finished is definitive regardless of elapsed time
+            if e < entries.n and budget_s is not None and \
+                    _time.monotonic() - t0 > budget_s:
+                timed_out = True
+                break
+        ok, death, overflow, max_count = k.summarize(carry)
+        ok = bool(ok) and not timed_out
+        overflow = bool(overflow) or timed_out
+        if ok or not overflow or F >= max_frontier or timed_out:
             break
         F *= 4  # invalid + overflow: the witness may have been dropped
     out = {
@@ -459,7 +530,11 @@ def analysis_tpu(model, hist, frontier: int = 1024, slots: int = 64,
         "final-paths": [],
     }
     if not ok:
-        if overflow:
+        if timed_out:
+            out["error"] = (
+                f"search exceeded the {budget_s} s budget at frontier "
+                f"{F}; verdict unknown")
+        elif overflow:
             # The death point is an artifact of dropped configs — do not
             # name a culprit op for an 'unknown' verdict.
             out["error"] = (
@@ -510,7 +585,7 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     if all_entries:
         E = _bucket(max(e.n for _, _, e in all_entries))
         padded = [e.pad_to(E) for _, _, e in all_entries]
-        _, check_batch = _kernel(name, frontier, slots, E)
+        check_batch = _kernel(name, frontier, slots, E).check_batch
         ok, death, overflow, max_count = check_batch(
             _stack([e.kind for e in padded]),
             _stack([e.slot for e in padded]),
@@ -584,7 +659,7 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
 
     from functools import partial
 
-    _, check_batch = _kernel(name, frontier, slots, E)
+    check_batch = _kernel(name, frontier, slots, E).check_batch
 
     # check_vma=False: the kernel's inner lax loops create fresh constants
     # whose varying-manual-axes tags can't match the sharded carries; the

@@ -4,9 +4,11 @@ same history arrays in, same verdicts out')."""
 
 import pytest
 
+from jepsen_tpu import models
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import synth
 from jepsen_tpu.checker.linear import analysis_host
+from jepsen_tpu.checker import wgl
 from jepsen_tpu.checker.wgl import (SlotOverflow, analysis_tpu,
                                     analysis_tpu_batch, build_entries,
                                     check_batch_sharded,
@@ -192,4 +194,49 @@ def test_slot_overflow_escalates_transparently():
         [op("invoke", "write", i, i) for i in range(8)]
         + [op("ok", "write", i, i) for i in range(8)])
     a = analysis_tpu(m.cas_register(), hist, frontier=4096, slots=4)
+    assert a["valid?"] is True
+
+
+# -- chunked execution / budget (long-search checkpointing) ------------------
+
+def test_required_slots():
+    from jepsen_tpu.checker.wgl import encode_ops_for_model, required_slots
+    h = synth.register_history(200, concurrency=4, values=5,
+                               crash_rate=0.0, seed=7)
+    ops = encode_ops_for_model(models.cas_register(), h)
+    assert 1 <= required_slots(ops) <= 4
+    # crashed ops hold slots forever
+    h2 = synth.register_history(200, concurrency=4, values=5,
+                                crash_rate=0.05, seed=7)
+    ops2 = encode_ops_for_model(models.cas_register(), h2)
+    assert required_slots(ops2) > required_slots(ops)
+
+
+def test_chunked_matches_single_call():
+    """Chunked execution must agree with the one-shot kernel."""
+    h = synth.register_history(400, concurrency=4, values=5,
+                               crash_rate=0.01, seed=11)
+    a1 = wgl.analysis_tpu(models.cas_register(), h, chunk_entries=10**9)
+    a2 = wgl.analysis_tpu(models.cas_register(), h, chunk_entries=64)
+    assert a1["valid?"] == a2["valid?"]
+
+
+def test_budget_returns_unknown():
+    """Past the wall-clock budget an undecided search degrades to
+    'unknown' rather than hanging."""
+    h = synth.register_history(600, concurrency=5, values=5,
+                               crash_rate=0.1, seed=3)  # exponential-ish
+    a = wgl.analysis_tpu(models.cas_register(), h, frontier=8,
+                         chunk_entries=16, budget_s=0.0)
+    assert a["valid?"] == "unknown"
+    assert "budget" in a["error"]
+
+
+def test_budget_never_downgrades_completed_search():
+    """A search that finishes all entries is definitive even when it
+    blew the budget — no 'unknown' for completed valid verdicts."""
+    h = synth.register_history(300, concurrency=4, values=5,
+                               crash_rate=0.0, seed=5)
+    a = wgl.analysis_tpu(models.cas_register(), h, budget_s=0.0,
+                         chunk_entries=10**9)
     assert a["valid?"] is True
