@@ -11,7 +11,7 @@ import importlib
 import json
 import urllib.request
 
-SUITES = ("etcd", "zookeeper", "hazelcast")
+SUITES = ("etcd", "zookeeper", "hazelcast", "consul")
 
 
 def suite(name: str):

@@ -1,0 +1,153 @@
+"""Consul suite tests: DB command generation, the index-CAS client
+against an in-process fake consul KV over real HTTP, and a hermetic
+suite run."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import consul, suite
+
+
+class FakeConsul:
+    """/v1/kv/<key>: GET returns [{Value: b64, ModifyIndex}], PUT with
+    ?cas=<index> succeeds iff index matches (0 = create)."""
+
+    def __init__(self):
+        self.kv: dict[str, tuple[str, int]] = {}
+        self.index = 0
+        self.lock = threading.Lock()
+        self.server = None
+
+    def start(self) -> int:
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                key = self.path.split("?")[0][len("/v1/kv/"):]
+                with fake.lock:
+                    if key not in fake.kv:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    val, idx = fake.kv[key]
+                body = json.dumps([{
+                    "Key": key,
+                    "Value": base64.b64encode(val.encode()).decode(),
+                    "ModifyIndex": idx,
+                }]).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PUT(self):  # noqa: N802
+                path, _, query = self.path.partition("?")
+                key = path[len("/v1/kv/"):]
+                n = int(self.headers.get("Content-Length", 0))
+                val = self.rfile.read(n).decode()
+                cas = None
+                if query.startswith("cas="):
+                    cas = int(query[4:])
+                with fake.lock:
+                    cur_idx = fake.kv.get(key, (None, 0))[1]
+                    ok = cas is None or cas == cur_idx
+                    if ok:
+                        fake.index += 1
+                        fake.kv[key] = (val, fake.index)
+                body = b"true" if ok else b"false"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        return self.server.server_address[1]
+
+    def stop(self):
+        if self.server:
+            self.server.shutdown()
+
+
+@pytest.fixture
+def fake():
+    f = FakeConsul()
+    f.port = f.start()
+    yield f
+    f.stop()
+
+
+def test_registry():
+    assert suite("consul") is consul
+
+
+def test_db_commands():
+    log = []
+    remote = dummy.remote(
+        log=log, responses={r"ls -A \.": "consul"})
+    test = {"nodes": ["n1", "n2"],
+            "tarball": "file:///tmp/consul.zip"}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            consul.db().setup(test, "n1")
+            log_cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+            assert "-bootstrap" in log_cmds      # n1 is primary
+            log.clear()
+        sess2 = control.session("n2")
+        with control.with_session("n2", sess2):
+            consul.db().start(test, "n2")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "-retry-join n1" in cmds
+
+
+def test_client_cas_semantics(fake):
+    t = {"consul-url-fn": lambda n: f"http://127.0.0.1:{fake.port}"}
+    c = consul.ConsulClient().open(t, "n1")
+    r = c.invoke(t, {"f": "read", "process": 0})
+    assert r["type"] == "ok" and r["value"] is None
+    assert c.invoke(t, {"f": "write", "value": 3,
+                        "process": 0})["type"] == "ok"
+    assert c.invoke(t, {"f": "cas", "value": [3, 4],
+                        "process": 0})["type"] == "ok"
+    assert c.invoke(t, {"f": "cas", "value": [3, 1],
+                        "process": 0})["type"] == "fail"
+    assert c.invoke(t, {"f": "read", "process": 0})["value"] == 4
+
+
+def test_client_refused_is_fail():
+    t = {"consul-url-fn": lambda n: "http://127.0.0.1:1"}
+    c = consul.ConsulClient(timeout_s=0.2).open(t, "n1")
+    assert c.invoke(t, {"f": "write", "value": 1,
+                        "process": 0})["type"] == "fail"
+
+
+def test_hermetic_suite_run(tmp_path, fake):
+    import jepsen_tpu.db
+    import jepsen_tpu.nemesis
+    import jepsen_tpu.os_
+    t = consul.consul_test({
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 3,
+        "ssh": {"dummy": True},
+        "rate": 100,
+        "time-limit": 2,
+        "store-dir": str(tmp_path / "store"),
+    })
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["nemesis"] = jepsen_tpu.nemesis.noop
+    t["consul-url-fn"] = lambda n: f"http://127.0.0.1:{fake.port}"
+    done = core.run(t)
+    assert done["results"]["valid?"] is True, done["results"]
+    assert len(done["history"]) > 10
