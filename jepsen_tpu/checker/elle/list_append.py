@@ -22,15 +22,14 @@ failed txn's append), G1b (observing an intermediate state of a
 multi-append txn), internal (a txn's read inconsistent with its own
 earlier ops).
 
-Cycle anomalies (G0/G1c/G-single/G2-item) are decided on device by
-`kernels.analyze_graph`; certificates are reconstructed host-side.
+Cycle anomalies (G0/G1c/G-single/G2-item) are decided by
+`kernels.analyze_edges` (sparse SCC condensation + batched MXU
+classification); certificates are reconstructed host-side.
 """
 
 from __future__ import annotations
 
 from typing import Any
-
-import numpy as np
 
 from ... import txn as mop
 from ...history import history as as_history, is_fail, is_info, is_ok
@@ -161,24 +160,27 @@ class _Analysis:
 
 
 def graph(hist):
-    """Build the dependency graph. Returns (txn_ops, ww, wr, rw, edges)
-    where txn_ops[i] is the i-th transaction (ok/info), the matrices are
-    n x n numpy bools, and edges maps (i, j) -> set of edge-type
-    strings for host-side explanation."""
+    """Build the sparse dependency graph. Returns (txn_ops, edges, a,
+    incompatible) where txn_ops[i] is the i-th transaction (ok/info) and
+    edges maps (i, j) -> set of edge-type strings.
+
+    rw edges stay linear in history size: a read of the chain prefix
+    ending at v_i anti-depends on writer(v_{i+1}) only — the *immediate*
+    in-chain successor; anti-dependencies on later versions are rw;ww*
+    composites reconstructed through the ww chain, which preserves both
+    cycle detection and the one-vs-many-rw classification. Appends never
+    observed in any read carry genuine information of their own — the
+    read proves they happened after its snapshot — so each reader
+    anti-depends on every never-observed :ok append of its key (crashed
+    never-observed appends may not have executed)."""
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    n = len(txns)
-    ww = np.zeros((n, n), bool)
-    wr = np.zeros((n, n), bool)
-    rw = np.zeros((n, n), bool)
     edges: dict[tuple, set] = {}
 
-    def add(mat, i, j, typ):
-        if i == j:
-            return
-        mat[i, j] = True
-        edges.setdefault((i, j), set()).add(typ)
+    def add(i, j, typ):
+        if i != j:
+            edges.setdefault((i, j), set()).add(typ)
 
     orders, incompatible = a.version_orders()
     # ww along each key's observed version chain
@@ -187,29 +189,48 @@ def graph(hist):
         for v1, v2 in zip(chain, chain[1:]):
             w1, w2 = writers.get(v1), writers.get(v2)
             if w1 and w2:
-                add(ww, idx[id(w1[0])], idx[id(w2[0])], "ww")
-    # wr + rw per read. A read returns the full prefix at its snapshot,
-    # so *any* append absent from it is a later version: the reader
-    # anti-depends on its writer (an rw;ww* composite — still exactly one
-    # anti-dependency, so classification is unaffected). Restricted to
-    # :ok writers: a crashed, never-observed append may not have executed.
+                add(idx[id(w1[0])], idx[id(w2[0])], "ww")
+    # never-observed :ok appends per key (not in the longest chain)
+    unobserved: dict[Any, list] = {}
+    for k, writers in a.writer_of.items():
+        observed = set(orders.get(k, ()))
+        un = [wop for v, (wop, _f) in writers.items()
+              if v not in observed and is_ok(wop)]
+        if un:
+            unobserved[k] = un
+    # wr + rw per read
     for o in a.oks:
+        i_reader = idx[id(o)]
         for m in o.get("value") or ():
             if not _is_read(m) or mop.value(m) is None:
                 continue
             k = mop.key(m)
             vs = list(mop.value(m))
             writers = a.writer_of.get(k, {})
+            chain = orders.get(k, [])
             if vs:
                 w = writers.get(vs[-1])
                 if w is not None and id(w[0]) != id(o):
-                    add(wr, idx[id(w[0])], idx[id(o)], "wr")
-            observed = set(vs)
-            for v, (wop, _final) in writers.items():
-                if v not in observed and is_ok(wop) \
-                        and id(wop) != id(o):
-                    add(rw, idx[id(o)], idx[id(wop)], "rw")
-    return txns, ww, wr, rw, edges, a, incompatible
+                    add(idx[id(w[0])], i_reader, "wr")
+            # first in-chain successor with a known writer (observed =>
+            # committed, so info writers count too). Versions with no
+            # known writer — phantom values a corrupt store fabricated —
+            # are skipped over, not stopped at, so the anti-dependency
+            # still lands on the next real writer. If that writer is
+            # the reader itself, its own ww chain edge carries the
+            # composite onward and no rw edge is needed.
+            p = len(vs)
+            while p < len(chain):
+                w2 = writers.get(chain[p])
+                if w2 is not None:
+                    if id(w2[0]) != id(o):
+                        add(i_reader, idx[id(w2[0])], "rw")
+                    break
+                p += 1
+            for wop in unobserved.get(k, ()):
+                if id(wop) != id(o):
+                    add(i_reader, idx[id(wop)], "rw")
+    return txns, edges, a, incompatible
 
 
 DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
@@ -222,7 +243,7 @@ def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
     'anomaly-types': [..], 'anomalies': {type: [case...]}}, matching the
     reference checker's result shape (`tests/cycle/append.clj:28-55`)."""
     hist = as_history(hist).index()
-    txns, ww, wr, rw, edges, a, incompatible = graph(hist)
+    txns, edges, a, incompatible = graph(hist)
     found: dict[str, list] = {}
 
     if a.duplicates:
@@ -239,7 +260,7 @@ def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
     if internal:
         found["internal"] = internal
 
-    cyc = kernels.analyze_graph(ww, wr, rw, mesh=mesh)
+    cyc = kernels.analyze_edges(len(txns), edges, mesh=mesh)
     found.update(kernels.certificates(txns, edges, cyc))
 
     reported = {t: cases for t, cases in found.items() if t in anomalies}

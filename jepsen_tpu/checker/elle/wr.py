@@ -26,8 +26,6 @@ from __future__ import annotations
 
 from typing import Any
 
-import numpy as np
-
 from ... import txn as mop
 from ...history import history as as_history, is_fail, is_info, is_ok
 from . import kernels
@@ -124,22 +122,16 @@ class _Analysis:
 
 
 def graph(hist):
-    """(txns, ww, wr, rw, edges, analysis) — see module docstring for the
-    edge-inference rules."""
+    """(txns, edges, analysis) — sparse dependency graph; see module
+    docstring for the edge-inference rules."""
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    n = len(txns)
-    ww = np.zeros((n, n), bool)
-    wr = np.zeros((n, n), bool)
-    rw = np.zeros((n, n), bool)
     edges: dict[tuple, set] = {}
 
-    def add(mat, i, j, typ):
-        if i == j:
-            return
-        mat[i, j] = True
-        edges.setdefault((i, j), set()).add(typ)
+    def add(i, j, typ):
+        if i != j:
+            edges.setdefault((i, j), set()).add(typ)
 
     # wr: writer -> external readers (exact)
     for o in a.oks:
@@ -148,7 +140,7 @@ def graph(hist):
                 continue
             w = a.writer_of.get((k, v))
             if w is not None:
-                add(wr, idx[id(w[0])], idx[id(o)], "wr")
+                add(idx[id(w[0])], idx[id(o)], "wr")
 
     pairs = a.version_pairs()
     writers_by_key: dict[Any, list] = {}
@@ -164,7 +156,7 @@ def graph(hist):
             if u is not _INIT:
                 wu = a.writer_of.get((k, u))
                 if wu is not None:
-                    add(ww, idx[id(wu[0])], idx[id(wv[0])], "ww")
+                    add(idx[id(wu[0])], idx[id(wv[0])], "ww")
 
     # rw: external reader of u -> writers of known successors of u;
     # a read of nil anti-depends on every writer of that key
@@ -176,13 +168,13 @@ def graph(hist):
         for k, v in mop.ext_reads(o.get("value") or ()).items():
             if v is None:
                 for _, w in writers_by_key.get(k, ()):
-                    add(rw, idx[id(o)], idx[id(w)], "rw")
+                    add(idx[id(o)], idx[id(w)], "rw")
             else:
                 for v2 in succ.get((k, v), ()):
                     w2 = a.writer_of.get((k, v2))
                     if w2 is not None:
-                        add(rw, idx[id(o)], idx[id(w2[0])], "rw")
-    return txns, ww, wr, rw, edges, a
+                        add(idx[id(o)], idx[id(w2[0])], "rw")
+    return txns, edges, a
 
 
 DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
@@ -193,7 +185,7 @@ def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
     """Full rw-register analysis; result shape mirrors the reference
     checker (`tests/cycle/wr.clj:46-54`)."""
     hist = as_history(hist).index()
-    txns, ww, wr, rw, edges, a = graph(hist)
+    txns, edges, a = graph(hist)
     found: dict[str, list] = {}
     if a.duplicates:
         found["duplicate-writes"] = a.duplicates
@@ -207,7 +199,7 @@ def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
     if internal:
         found["internal"] = internal
 
-    cyc = kernels.analyze_graph(ww, wr, rw, mesh=mesh)
+    cyc = kernels.analyze_edges(len(txns), edges, mesh=mesh)
     found.update(kernels.certificates(txns, edges, cyc))
 
     reported = {t: cases for t, cases in found.items() if t in anomalies}

@@ -85,9 +85,16 @@ def _closure(configs: set, pending: dict) -> set:
     return seen
 
 
-def analysis_host(model: m.Model, hist) -> dict:
+def analysis_host(model: m.Model, hist, budget_s: float | None = None,
+                  cancel=None) -> dict:
     """Run the JIT-linearization search on the host. Returns an analysis map
-    with 'valid?' plus failure diagnostics."""
+    with 'valid?' plus failure diagnostics.
+
+    budget_s: wall-clock budget; past it the search stops with
+    {'valid?': 'unknown'} (the reference bounds knossos the same way, via
+    memory/`concurrency-limit`, `checker.clj:101-116`). cancel: optional
+    zero-arg callable polled between events — truthy stops the search
+    (used by competition racing, `checker.clj:199-203`)."""
     t0 = _time.monotonic()
     events = _prepare(as_history(hist).index())
     empty: frozenset = frozenset()
@@ -96,6 +103,14 @@ def analysis_host(model: m.Model, hist) -> dict:
     op_count = sum(1 for e in events if e[0] == "invoke")
     previous_ok = None
     for kind, op_id, op in events:
+        if budget_s is not None and _time.monotonic() - t0 > budget_s:
+            return {"valid?": UNKNOWN, "analyzer": "host-jit-linear",
+                    "op-count": op_count, "cause": "budget exhausted",
+                    "duration-ms": (_time.monotonic() - t0) * 1e3}
+        if cancel is not None and cancel():
+            return {"valid?": UNKNOWN, "analyzer": "host-jit-linear",
+                    "op-count": op_count, "cause": "cancelled",
+                    "duration-ms": (_time.monotonic() - t0) * 1e3}
         if kind == "invoke":
             pending[op_id] = op
             continue

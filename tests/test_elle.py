@@ -360,6 +360,137 @@ def test_elle_ignores_nemesis_ops():
     assert res2["valid?"] is True
 
 
+# -- sparse SCC pipeline at scale --------------------------------------------
+
+def test_scc_labels_matches_tarjan_fallback():
+    rng = np.random.default_rng(3)
+    n = 200
+    m = 600
+    src = rng.integers(0, n, m)
+    dst = rng.integers(0, n, m)
+    a = kernels.scc_labels(n, src, dst)
+    b = kernels._tarjan_labels(n, src, dst)
+    # identical partitions (label ids may differ): bijective label map
+    fwd, bwd = {}, {}
+    for x, y in zip(a.tolist(), b.tolist()):
+        assert fwd.setdefault(x, y) == y
+        assert bwd.setdefault(y, x) == x
+
+
+def test_analyze_edges_valid_at_scale():
+    from jepsen_tpu.checker import synth
+    h = synth.append_history(5000)
+    res = list_append.check(h)
+    assert res["valid?"] is True
+    assert res["txn-count"] == 5000
+
+
+def test_analyze_edges_many_injected_sccs():
+    from jepsen_tpu.checker import synth
+    h = synth.inject_append_cycles(synth.append_history(500), 20, "G1c")
+    res = list_append.check(h)
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+    cert = res["anomalies"]["G1c"][0]["cycle"]
+    assert cert is not None and cert[0]["index"] == cert[-1]["index"]
+
+
+def test_analyze_edges_g_single_injected():
+    from jepsen_tpu.checker import synth
+    h = synth.inject_append_cycles(synth.append_history(300), 5,
+                                   "G-single")
+    res = list_append.check(h)
+    assert res["valid?"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_analyze_edges_sharded_mesh():
+    import jax
+    from jax.sharding import Mesh
+    from jepsen_tpu.checker import synth
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("keys",))
+    h = synth.inject_append_cycles(synth.append_history(300), 11, "G1c")
+    res = list_append.check(h, mesh=mesh)
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_analyze_edges_oversized_scc_host_path():
+    # force the oversized path with a tiny max_dense: a 4-node G1c ring
+    # plus a disjoint 2-node G0 ring
+    edges = {(0, 1): {"ww"}, (1, 2): {"wr"}, (2, 3): {"ww"},
+             (3, 0): {"wr"}, (4, 5): {"ww"}, (5, 4): {"ww"}}
+    res = kernels.analyze_edges(6, edges, max_dense=3)
+    assert res["oversized-sccs"] == 1  # the 4-ring
+    assert res["G0"] and res["G1c"]
+    assert not res["G-single"] and not res["G2-item"]
+
+
+def test_analyze_edges_oversized_scc_with_outgoing_edges():
+    # an oversized SCC with edges leaving the SCC must still classify
+    # (regression: dst-outside-SCC edges crashed the host classifier)
+    edges = {(0, 1): {"ww"}, (1, 2): {"ww"}, (2, 0): {"ww"},
+             (2, 3): {"ww"}, (3, 4): {"wr"}}
+    res = kernels.analyze_edges(5, edges, max_dense=2)
+    assert res["G0"] and res["G1c"]
+
+
+def test_analyze_edges_oversized_g2_not_masked_by_g1c():
+    # one SCC containing BOTH a wr-cycle (G1c) and a 2-rw cycle (G2);
+    # the oversized path must report both, independently
+    edges = {(0, 1): {"wr"}, (1, 0): {"wr"},          # G1c ring
+             (1, 2): {"rw"}, (2, 1): {"rw"}}          # 2-rw ring
+    res = kernels.analyze_edges(3, edges, max_dense=2)
+    assert res["G1c"] and res["G2-item"]
+    dense = kernels.analyze_edges(3, edges, max_dense=4096)
+    assert dense["G1c"] and dense["G2-item"]
+
+
+def test_analyze_edges_self_loops():
+    r = kernels.analyze_edges(2, {(0, 0): {"ww"}})
+    assert r["G0"] and r["G1c"] and 0 in r["cycle-nodes"]
+    r2 = kernels.analyze_edges(2, {(1, 1): {"rw"}})
+    assert r2["G-single"] and not r2["G0"]
+    # dense adapter with a true diagonal
+    ww = np.zeros((2, 2), bool)
+    ww[1, 1] = True
+    assert kernels.analyze_graph(ww, np.zeros_like(ww),
+                                 np.zeros_like(ww))["G0"]
+
+
+def test_analyze_edges_oversized_g_single_and_g2():
+    # oversized classification distinguishes one-rw from >=2-rw cycles
+    e1 = {(0, 1): {"rw"}, (1, 2): {"ww"}, (2, 0): {"wr"}}
+    r1 = kernels.analyze_edges(3, e1, max_dense=2)
+    assert r1["G-single"] and not r1["G1c"] and not r1["G2-item"]
+    e2 = {(0, 1): {"rw"}, (1, 2): {"ww"}, (2, 3): {"rw"}, (3, 0): {"ww"}}
+    r2 = kernels.analyze_edges(4, e2, max_dense=2)
+    assert r2["G2-item"] and not r2["G-single"]
+
+
+def test_append_phantom_value_does_not_hide_anti_dependency():
+    # a corrupt store fabricates value 9 in x's chain [1, 9, 2]; the
+    # reader of [1] must still anti-depend on the (real) writer of 2,
+    # closing a G-single cycle through T2 -wr-> R on k2
+    h = history(
+        _ok(0, [["append", "x", 1]], 0)
+        + _ok(1, [["append", "x", 2], ["append", "k2", 5]], 2)
+        + _ok(2, [["r", "x", [1]], ["r", "k2", [5]]], 4)
+        + _ok(3, [["r", "x", [1, 9, 2]]], 6))
+    res = list_append.check(h)
+    assert res["valid?"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_wr_history_synth_valid():
+    from jepsen_tpu.checker import synth
+    h = synth.wr_history(3000)
+    res = wr.check(h)
+    assert res["valid?"] is True
+    assert res["txn-count"] == 3000
+
+
 def test_g_single_certificate_has_exactly_one_rw():
     h = history(
         _ok(0, [["append", "x", 1], ["append", "y", 1]], 0)
