@@ -167,13 +167,15 @@ def _flags_batch_fn(e: int, steps: int):
     return batch
 
 
-def _classify_batches(buckets: dict, mesh=None) -> tuple:
+def _classify_batches(buckets: dict, mesh=None) -> dict:
     """Run the batched classifier per bucket size. buckets maps
-    e -> (ww[B,e,e], wr, rw) float32 numpy. Returns OR-reduced flags."""
+    e -> (ww[B,e,e], wr, rw) float32 numpy. Returns
+    e -> (g0[B], g1c[B], single[B], g2[B]) bool numpy — per-SCC flags,
+    in the caller's slot order."""
     import jax
     import jax.numpy as jnp
 
-    g0 = g1c = single = g2 = False
+    out: dict = {}
     for e, (ww, wr, rw) in sorted(buckets.items()):
         steps = max(1, math.ceil(math.log2(max(e, 2))))
         fn = _flags_batch_fn(e, steps)
@@ -192,11 +194,36 @@ def _classify_batches(buckets: dict, mesh=None) -> tuple:
         else:
             args = [jnp.asarray(a) for a in args]
         f0, f1, fs, f2 = fn(*args)
-        g0 = g0 or bool(np.asarray(f0)[:b].any())
-        g1c = g1c or bool(np.asarray(f1)[:b].any())
-        single = single or bool(np.asarray(fs)[:b].any())
-        g2 = g2 or bool(np.asarray(f2)[:b].any())
-    return g0, g1c, single, g2
+        out[e] = tuple(np.asarray(x)[:b] for x in (f0, f1, fs, f2))
+    return out
+
+
+def _edges_dict(src, dst, tmask) -> tuple[dict, list]:
+    """COO arrays -> ({(i, j): {types}}, [rw edges])."""
+    edges: dict[tuple, set] = {}
+    rw_edges = []
+    for i, j, t in zip((int(x) for x in src), (int(x) for x in dst),
+                       (int(x) for x in tmask)):
+        types = edges.setdefault((i, j), set())
+        if t & _WW:
+            types.add("ww")
+        if t & _WR:
+            types.add("wr")
+        if t & _RW:
+            types.add("rw")
+            rw_edges.append((i, j))
+    return edges, rw_edges
+
+
+def _probe_g2(src, dst, tmask, probe_cap: int = 2000) -> bool:
+    """Host check for a >=2-anti-dependency cycle in a (small) subgraph:
+    for each rw edge (i, j), look for a return path j => i using another
+    rw edge and never revisiting i mid-path."""
+    edges, rw_edges = _edges_dict(src, dst, tmask)
+    for i, j in rw_edges[:probe_cap]:
+        if _find_g2_path(edges, j, i, exclude_src=i):
+            return True
+    return False
 
 
 def _classify_oversized(nodes: np.ndarray, src, dst, tmask,
@@ -224,18 +251,8 @@ def _classify_oversized(nodes: np.ndarray, src, dst, tmask,
     g0 = has_subcycle(_WW)
     g1c = g0 or has_subcycle(_WW | _WR)
     # probes over rw edges: G-single = a ww/wr-only return path;
-    # G2-item = a return path using at least one more rw edge
-    sub_edges: dict[tuple, set] = {}
-    rw_edges = []
-    for i, j, t in sub:
-        types = sub_edges.setdefault((i, j), set())
-        if t & _WW:
-            types.add("ww")
-        if t & _WR:
-            types.add("wr")
-        if t & _RW:
-            types.add("rw")
-            rw_edges.append((i, j))
+    # G2-item = a return path using a second anti-dependency
+    sub_edges, rw_edges = _edges_dict(*zip(*sub)) if sub else ({}, [])
     single = g2 = False
     probed_all = len(rw_edges) <= probe_cap
     for i, j in rw_edges[:probe_cap]:
@@ -372,9 +389,20 @@ def analyze_edges(n: int, edges: dict, mesh=None,
                 rw[s, r, c] = 1.0
         buckets[e] = (ww, wr, rw)
     if buckets:
-        f0, f1, fs, f2 = _classify_batches(buckets, mesh=mesh)
-        g0, g1c = g0 or f0, g1c or f1
-        single, g2 = single or fs, g2 or f2
+        flags = _classify_batches(buckets, mesh=mesh)
+        for e, (f0, f1, fs, f2) in flags.items():
+            g0 = g0 or bool(f0.any())
+            g1c = g1c or bool(f1.any())
+            single = single or bool(fs.any())
+            # the dense distinct-rw-sources G2 test can be fooled by two
+            # one-rw cycles sharing a node: host-verify each flagged SCC
+            # with the stricter probe before believing it
+            for ix in np.flatnonzero(f2):
+                if g2:
+                    break
+                lab = by_bucket[e][int(ix)]
+                emask = e_lab == lab
+                g2 = _probe_g2(e_src[emask], e_dst[emask], e_t[emask])
 
     out["G0"] = out["G0"] or g0
     out["G1c"] = out["G1c"] or g1c
@@ -489,30 +517,61 @@ def find_path(edges: dict, src: int, dst: int, allowed: set) -> list | None:
 
 
 def _find_g2_path(edges: dict, src: int, dst: int,
-                  exclude_src: int | None = None) -> list | None:
-    """Shortest src -> dst path over all edges that traverses at least
-    one rw edge — state-augmented BFS (node, rw-used?).
+                  exclude_src: int | None = None,
+                  step_budget: int = 200_000) -> list | None:
+    """A *simple* src -> dst path over all edges that traverses at
+    least one rw edge — closing a G2 cycle with the rw edge
+    (exclude_src -> src), whose own rw must not be double-counted
+    (rw edges out of exclude_src don't set the flag).
 
-    exclude_src: rw edges originating at this node don't count toward
-    the rw-used flag. Used when probing for a second anti-dependency to
-    close a G2 cycle that already uses an rw edge out of `exclude_src` —
-    a walk re-entering the same rw edge would double-count one
-    anti-dependency (the dense kernel's distinct-rw-sources test,
-    mirrored host-side)."""
-    from collections import deque
-
+    Simple-path search is what makes the answer exact: a walk that
+    revisits a node stitches two one-rw cycles into a figure-eight,
+    which is not a simple cycle and must not count as G2 (two G-single
+    cycles sharing a node are still G-single). DFS with per-path
+    visited sets is exponential in the worst case, so a step budget
+    guards it; on exhaustion we fall back to the polynomial
+    state-BFS over (node, rw-used?) — an over-approximation that can
+    mislabel a figure-eight as G2, conservative toward reporting the
+    (definitely present) cyclic anomaly."""
     adj: dict[int, list] = {}
     for (i, j), types in edges.items():
         counts = "rw" in types and i != exclude_src
         adj.setdefault(i, []).append((j, counts))
+
+    stack: list = [(src, False, (src,))]
+    steps = 0
+    while stack:
+        steps += 1
+        if steps > step_budget:
+            return _g2_walk_fallback(adj, src, dst)
+        node, used, path = stack.pop()
+        for nxt, is_rw in adj.get(node, ()):
+            u = used or is_rw
+            if nxt == dst:
+                if u:
+                    return list(path) + [nxt]
+                continue  # dst is an endpoint, never an intermediate
+            if nxt == exclude_src or nxt in path:
+                continue
+            stack.append((nxt, u, path + (nxt,)))
+    return None
+
+
+def _g2_walk_fallback(adj: dict, src: int, dst: int) -> list | None:
+    """Polynomial over-approximation used past the simple-path budget:
+    shortest walk with >= 1 counted rw, nodes reusable."""
+    from collections import deque
+
     q = deque([(src, False, [src])])
     seen = {(src, False)}
     while q:
         node, used, path = q.popleft()
         for nxt, is_rw in adj.get(node, ()):
             u = used or is_rw
-            if nxt == dst and u:
-                return path + [nxt]
+            if nxt == dst:
+                if u:
+                    return path + [nxt]
+                continue
             if (nxt, u) not in seen:
                 seen.add((nxt, u))
                 q.append((nxt, u, path + [nxt]))

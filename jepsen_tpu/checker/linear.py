@@ -127,7 +127,7 @@ def analysis_host(model: m.Model, hist, budget_s: float | None = None,
                 "configs": [_config_info(c, pending)
                             for c in sorted(expanded,
                                             key=lambda c: -len(c[1]))[:10]],
-                "final-paths": [],
+                "final-paths": _final_paths(configs, pending, op, op_id),
                 "duration-ms": (_time.monotonic() - t0) * 1e3,
             }
         del pending[op_id]
@@ -140,6 +140,76 @@ def analysis_host(model: m.Model, hist, budget_s: float | None = None,
                         for c in list(configs)[:10]],
             "final-paths": [],
             "duration-ms": (_time.monotonic() - t0) * 1e3}
+
+
+def _brief(op: dict) -> dict:
+    return {k: op.get(k) for k in ("index", "process", "f", "value")}
+
+
+def _final_paths(configs: set, pending: dict, death_op: dict,
+                 death_id: int, cap: int = 10,
+                 max_steps: int = 6) -> list:
+    """Reconstruct failure paths for a nonlinearizable verdict — the
+    analog of knossos's final-paths (rendered by the reference at
+    `checker.clj:205-216`). Each path is a sequence of
+    {'op', 'model'} steps: a legal linearization of pending ops from a
+    surviving configuration, ending with the failing attempt to
+    linearize the culprit op and the resulting model inconsistency."""
+    paths: list = []
+    for mod, lin in sorted(configs, key=lambda c: -len(c[1]))[:cap]:
+        if len(paths) >= cap:
+            break
+        avail = {i: op for i, op in pending.items()
+                 if i not in lin and i != death_id}
+        stack: list = [(mod, (), frozenset())]
+        seen = set()
+        while stack and len(paths) < cap:
+            m0, steps, used = stack.pop()
+            dm = m0.step(death_op)
+            if m.is_inconsistent(dm):
+                paths.append(
+                    [*steps, {"op": _brief(death_op), "model": repr(dm)}])
+            if len(steps) >= max_steps:
+                continue
+            for i, op in avail.items():
+                if i in used:
+                    continue
+                m2 = m0.step(op)
+                if m.is_inconsistent(m2):
+                    continue
+                key = (m2, used | {i})
+                if key in seen:
+                    continue
+                seen.add(key)
+                stack.append(
+                    (m2, (*steps, {"op": _brief(op), "model": repr(m2)}),
+                     used | {i}))
+    return paths[:cap]
+
+
+def explain_failure(model: m.Model, hist, op_index: int,
+                    budget_s: float | None = 60.0) -> dict | None:
+    """Host re-search of the history prefix ending at the culprit op's
+    completion — reconstructs configs and final-paths for a device
+    'invalid' verdict (the device kernel reports only the death op).
+    Returns the host analysis, or None if the prefix can't be found or
+    the budget expires."""
+    hist = as_history(hist)
+    if hist.ops and "index" not in hist.ops[0]:
+        hist = hist.index()
+    pos = None
+    for i, o in enumerate(hist.ops):
+        if o.get("index") == op_index:
+            j = hist.pair_index().get(i)
+            pos = j if j is not None else i
+            break
+    if pos is None:
+        return None
+    prefix = History(hist.ops[:pos + 1])
+    a = analysis_host(model, prefix, budget_s=budget_s)
+    if a["valid?"] is not False:
+        return None
+    return a
 
 
 def _config_info(config, pending) -> dict:
@@ -155,8 +225,17 @@ class Linearizable(Checker):
       'host'  — pure-Python JIT-linearization (any model)
       'tpu'   — JAX frontier-BFS kernel (enumerable-state models)
       'auto'  — tpu when the model has a device form, else host
-      'linear'/'wgl'/'competition' — accepted aliases (reference names);
-                 mapped to 'auto'.
+      'competition' — race host against tpu in parallel; the first
+                 definitive verdict wins and the loser is cancelled
+                 (reference dispatch at checker.clj:199-203). Also the
+                 natural home for histories that overflow device slots:
+                 the host thread keeps going where the kernel gives up.
+      'linear'/'wgl' — accepted aliases (reference names) for 'auto'.
+
+    On a definite invalid verdict with an op-index, writes the failure
+    neighborhood to linear.svg in the test's store directory (the
+    reference renders knossos analyses the same way,
+    checker.clj:205-212).
     """
 
     def __init__(self, model: m.Model, algorithm: str = "auto", **opts):
@@ -168,25 +247,81 @@ class Linearizable(Checker):
 
     def check(self, test, hist, opts):
         algo = self.algorithm
-        if algo in ("linear", "wgl", "competition"):
+        if algo in ("linear", "wgl"):
             algo = "auto"
         elif algo == "tpu-wgl":
             algo = "tpu"
-        if algo not in ("auto", "tpu", "host"):
+        if algo not in ("auto", "tpu", "host", "competition"):
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
-        if algo in ("auto", "tpu"):
+        a = None
+        if algo == "competition" and self.model.device_model is not None:
+            a = self._compete(hist)
+        elif algo in ("auto", "tpu", "competition"):
             if self.model.device_model is not None:
                 try:
                     from .wgl import analysis_tpu
                     a = analysis_tpu(self.model, hist, **self.opts)
-                    return _truncate(a)
                 except ImportError:
                     if algo == "tpu":
                         raise
             elif algo == "tpu":
                 return {"valid?": UNKNOWN,
                         "error": f"model {self.model!r} has no device form"}
-        return _truncate(analysis_host(self.model, hist))
+        if a is None:
+            a = analysis_host(self.model, hist)
+        a = _truncate(a)
+        try:
+            from .explain import write_failure_svg
+            write_failure_svg(test or {}, opts, a, hist)
+        except OSError:  # unwritable store is not a checking failure
+            pass
+        return a
+
+    def _compete(self, hist) -> dict:
+        """Race the host search against the device kernel; first
+        definitive (non-'unknown') verdict wins, loser is cancelled."""
+        import queue as _queue
+        import threading
+
+        cancel = threading.Event()
+        results: _queue.Queue = _queue.Queue()
+
+        def run(name, fn):
+            try:
+                results.put((name, fn()))
+            except Exception as e:  # noqa: BLE001 — loser may die racing
+                results.put((name, {"valid?": UNKNOWN, "error": repr(e)}))
+
+        from .wgl import analysis_tpu
+        opts = dict(self.opts)
+        opts["explain"] = False  # explain after the race, not during it
+        threads = [
+            threading.Thread(
+                target=run, daemon=True,
+                args=("host", lambda: analysis_host(
+                    self.model, hist, cancel=cancel.is_set))),
+            threading.Thread(
+                target=run, daemon=True,
+                args=("tpu", lambda: analysis_tpu(
+                    self.model, hist, cancel=cancel.is_set, **opts))),
+        ]
+        for t in threads:
+            t.start()
+        a = None
+        for _ in threads:
+            name, r = results.get()
+            if r.get("valid?") != UNKNOWN:
+                cancel.set()
+                r["competition-winner"] = name
+                if r["valid?"] is False and not r.get("final-paths") \
+                        and "op-index" in r:
+                    ex = explain_failure(self.model, hist, r["op-index"])
+                    if ex is not None:
+                        r["configs"] = ex["configs"]
+                        r["final-paths"] = ex["final-paths"]
+                return r
+            a = r
+        return a  # both indefinite
 
 
 def _truncate(a: dict) -> dict:

@@ -457,7 +457,9 @@ def encode_ops_for_model(model, hist) -> OpArray:
 def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                  max_frontier: int = 65536,
                  chunk_entries: int = 4096,
-                 budget_s: float | None = None) -> dict:
+                 budget_s: float | None = None,
+                 cancel=None,
+                 explain: bool = True) -> dict:
     """Check one history on the device. The slot count is sized to the
     history's actual peak concurrency; long histories run as a sequence
     of bounded-duration chunked kernel calls with the frontier carried
@@ -469,7 +471,13 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
     budget_s caps total wall time: past it, an undecided search returns
     'unknown' instead of escalating further (histories with many
     crashed mutating ops are genuinely exponential — the reference's
-    checker hits the same wall as an OOM or its 1 h timeout)."""
+    checker hits the same wall as an OOM or its 1 h timeout).
+
+    cancel: zero-arg callable polled between chunks — truthy stops the
+    search with 'unknown' (competition racing). explain: on a definite
+    invalid verdict, re-run the host oracle on the prefix ending at the
+    culprit op to reconstruct configs and final-paths (the reference
+    renders these via knossos.linear.report, `checker.clj:205-216`)."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
@@ -486,7 +494,7 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
             entries = build_entries(ops, slots)
     if slots > 256:
         from .linear import analysis_host
-        a = analysis_host(model, hist)
+        a = analysis_host(model, hist, budget_s=budget_s, cancel=cancel)
         a["analyzer"] = "host-jit-linear (slot overflow)"
         return a
     E = _bucket(max(entries.n, 1))
@@ -495,7 +503,7 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
             jnp.asarray(entries.f), jnp.asarray(entries.a),
             jnp.asarray(entries.b))
     F = frontier
-    timed_out = False
+    timed_out = cancelled = False
     while True:
         k = _kernel(name, F, slots, E)
         carry = k.init_carry(jnp.int32(model.device_state()))
@@ -508,10 +516,14 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                 break
             # only give up when chunks remain — a search that just
             # finished is definitive regardless of elapsed time
-            if e < entries.n and budget_s is not None and \
-                    _time.monotonic() - t0 > budget_s:
-                timed_out = True
-                break
+            if e < entries.n:
+                if budget_s is not None and \
+                        _time.monotonic() - t0 > budget_s:
+                    timed_out = True
+                    break
+                if cancel is not None and cancel():
+                    timed_out = cancelled = True
+                    break
         ok, death, overflow, max_count = k.summarize(carry)
         ok = bool(ok) and not timed_out
         overflow = bool(overflow) or timed_out
@@ -530,7 +542,9 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
         "final-paths": [],
     }
     if not ok:
-        if timed_out:
+        if cancelled:
+            out["error"] = "search cancelled (competition loser)"
+        elif timed_out:
             out["error"] = (
                 f"search exceeded the {budget_s} s budget at frontier "
                 f"{F}; verdict unknown")
@@ -546,6 +560,14 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                 src_index = int(ops.index[row])
                 out["op"] = _find_op(hist, src_index)
                 out["op-index"] = src_index
+                if explain:
+                    from .linear import explain_failure
+                    ex = explain_failure(model, hist, src_index)
+                    if ex is not None:
+                        out["configs"] = ex["configs"]
+                        out["final-paths"] = ex["final-paths"]
+                        if ex.get("previous-ok") is not None:
+                            out["previous-ok"] = ex["previous-ok"]
     return out
 
 

@@ -317,10 +317,15 @@ class IndependentChecker(Checker):
 
     Device-model linearizability subcheckers take the batched TPU path:
     one vmapped kernel call over all keys instead of per-key host checks.
+
+    strict_device=True turns a failed device batch into a raised error
+    instead of a silent host fallback — use in tests/CI so a broken
+    kernel can't hide behind the (correct but slow) host oracle.
     """
 
-    def __init__(self, subchecker):
+    def __init__(self, subchecker, strict_device: bool = False):
         self.subchecker = coerce(subchecker)
+        self.strict_device = strict_device
 
     def _batched_tpu(self, test, hist, opts, ks):
         """Batched per-key device check, or None if not applicable."""
@@ -338,7 +343,14 @@ class IndependentChecker(Checker):
         try:
             return dict(zip(ks, analysis_tpu_batch(c.model, subs,
                                                    **c.opts)))
-        except Exception:  # noqa: BLE001 — fall back to per-key checks
+        except Exception:
+            if self.strict_device:
+                raise
+            import logging
+            logging.getLogger(__name__).warning(
+                "batched device check failed; falling back to per-key "
+                "host checks (pass strict_device=True to raise instead)",
+                exc_info=True)
             return None
 
     def check(self, test, hist, opts):
@@ -362,5 +374,5 @@ class IndependentChecker(Checker):
         }
 
 
-def checker(subchecker) -> Checker:
-    return IndependentChecker(subchecker)
+def checker(subchecker, strict_device: bool = False) -> Checker:
+    return IndependentChecker(subchecker, strict_device=strict_device)

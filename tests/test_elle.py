@@ -447,6 +447,19 @@ def test_analyze_edges_oversized_g2_not_masked_by_g1c():
     assert dense["G1c"] and dense["G2-item"]
 
 
+def test_two_g_single_cycles_sharing_a_node_are_not_g2():
+    # cycle A: 0-rw->1-ww->0; cycle B: 0-ww->2-rw->3-ww->0. Every simple
+    # cycle has exactly one anti-dependency; stitching them through the
+    # shared node 0 is not a simple cycle, so G2-item must stay False
+    # (regression: the distinct-rw-sources test alone reports G2)
+    edges = {(0, 1): {"rw"}, (1, 0): {"ww"}, (0, 2): {"ww"},
+             (2, 3): {"rw"}, (3, 0): {"ww"}}
+    for max_dense in (2, 4096):
+        res = kernels.analyze_edges(4, edges, max_dense=max_dense)
+        assert res["G-single"], max_dense
+        assert not res["G2-item"], max_dense
+
+
 def test_analyze_edges_self_loops():
     r = kernels.analyze_edges(2, {(0, 0): {"ww"}})
     assert r["G0"] and r["G1c"] and 0 in r["cycle-nodes"]

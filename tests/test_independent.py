@@ -137,6 +137,27 @@ def test_independent_checker_tpu_batched():
     assert "tpu" in res["results"]["a"]["analyzer"]
 
 
+def test_independent_strict_device_raises_and_default_falls_back(
+        caplog, monkeypatch):
+    import logging
+    import pytest
+    from jepsen_tpu.checker import wgl
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated kernel breakage")
+
+    monkeypatch.setattr(wgl, "analysis_tpu_batch", boom)
+    c = linearizable(cas_register(), "auto")
+    with pytest.raises(RuntimeError, match="simulated"):
+        independent.checker(c, strict_device=True).check(
+            {}, _kv_history(), {})
+    # default: loud warning, correct per-key fallback verdict
+    with caplog.at_level(logging.WARNING, "jepsen_tpu.independent"):
+        res = independent.checker(c).check({}, _kv_history(), {})
+    assert res["valid?"] is False and res["failures"] == ["b"]
+    assert any("falling back" in r.message for r in caplog.records)
+
+
 def test_concurrent_generator_skips_empty_key_generators():
     # keys 0-1 yield empty generators; productive keys must still run
     def fgen(k):

@@ -240,3 +240,83 @@ def test_budget_never_downgrades_completed_search():
     a = wgl.analysis_tpu(models.cas_register(), h, budget_s=0.0,
                          chunk_entries=10**9)
     assert a["valid?"] is True
+
+
+def test_invalid_verdict_carries_final_paths_and_configs():
+    """Device 'invalid' verdicts reconstruct knossos-style explanations
+    via a host re-search of the failing prefix (checker.clj:205-216)."""
+    h = synth.corrupt(synth.register_history(300, concurrency=4, values=5,
+                                             crash_rate=0.0, seed=9))
+    a = wgl.analysis_tpu(models.cas_register(), h)
+    assert a["valid?"] is False
+    assert a["op"] is not None
+    assert a["final-paths"], "failure must carry final paths"
+    # each path ends with the failing attempt at the culprit op
+    for path in a["final-paths"]:
+        assert "Inconsistent" in path[-1]["model"] or \
+            "inconsistent" in path[-1]["model"].lower()
+    assert a["configs"]
+
+
+def test_explain_off_skips_host_re_search():
+    h = synth.corrupt(synth.register_history(300, concurrency=4, values=5,
+                                             crash_rate=0.0, seed=9))
+    a = wgl.analysis_tpu(models.cas_register(), h, explain=False)
+    assert a["valid?"] is False
+    assert a["final-paths"] == []
+
+
+def test_linear_svg_written_to_store(tmp_path):
+    from jepsen_tpu.checker.linear import linearizable
+
+    h = synth.corrupt(synth.register_history(200, concurrency=4, values=5,
+                                             crash_rate=0.0, seed=13))
+    test = {"name": "svgtest", "start-time": "t0",
+            "store-dir": str(tmp_path)}
+    c = linearizable(models.cas_register(), "auto")
+    res = c.check(test, h, {})
+    assert res["valid?"] is False
+    svg = tmp_path / "svgtest" / "t0" / "linear.svg"
+    assert svg.exists()
+    body = svg.read_text()
+    assert "nonlinearizable" in body and "final paths" in body
+
+
+def test_competition_mode():
+    from jepsen_tpu.checker.linear import linearizable
+
+    good = synth.register_history(300, concurrency=4, values=5,
+                                  crash_rate=0.0, seed=21)
+    bad = synth.corrupt(good)
+    c = linearizable(models.cas_register(), "competition")
+    r1 = c.check({}, good, {})
+    assert r1["valid?"] is True and r1["competition-winner"] in ("host",
+                                                                "tpu")
+    r2 = c.check({}, bad, {})
+    assert r2["valid?"] is False
+
+
+def test_competition_host_only_model():
+    # a model with no device form competes by just running the host
+    from jepsen_tpu.checker.linear import linearizable
+    from jepsen_tpu.models import Model
+
+    class Weird(Model):
+        device_model = None
+
+        def step(self, op):
+            return self
+
+    h = synth.register_history(50, concurrency=3, values=3,
+                               crash_rate=0.0, seed=2)
+    r = linearizable(Weird(), "competition").check({}, h, {})
+    assert r["valid?"] is True
+
+
+def test_cancel_hook_stops_device_search():
+    h = synth.register_history(600, concurrency=5, values=5,
+                               crash_rate=0.1, seed=3)
+    a = wgl.analysis_tpu(models.cas_register(), h, frontier=8,
+                         chunk_entries=16, cancel=lambda: True)
+    assert a["valid?"] == "unknown"
+    assert "cancelled" in a["error"]
