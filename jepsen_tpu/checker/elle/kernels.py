@@ -218,12 +218,14 @@ def _edges_dict(src, dst, tmask) -> tuple[dict, list]:
 def _probe_g2(src, dst, tmask, probe_cap: int = 2000) -> bool:
     """Host check for a >=2-anti-dependency cycle in a (small) subgraph:
     for each rw edge (i, j), look for a return path j => i using another
-    rw edge and never revisiting i mid-path."""
+    rw edge and never revisiting i mid-path. Exact when every rw edge is
+    probed; past probe_cap, defers to the device's (over-approximate)
+    G2 flag rather than silently dropping a possibly-real anomaly."""
     edges, rw_edges = _edges_dict(src, dst, tmask)
     for i, j in rw_edges[:probe_cap]:
         if _find_g2_path(edges, j, i, exclude_src=i):
             return True
-    return False
+    return len(rw_edges) > probe_cap
 
 
 def _classify_oversized(nodes: np.ndarray, src, dst, tmask,

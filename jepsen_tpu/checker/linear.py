@@ -121,6 +121,7 @@ def analysis_host(model: m.Model, hist, budget_s: float | None = None,
             return {
                 "valid?": False,
                 "op": op,
+                "op-index": op.get("index"),
                 "previous-ok": previous_ok,
                 "op-count": op_count,
                 "analyzer": "host-jit-linear",
@@ -295,6 +296,9 @@ class Linearizable(Checker):
         from .wgl import analysis_tpu
         opts = dict(self.opts)
         opts["explain"] = False  # explain after the race, not during it
+        # on slot overflow the device path would duplicate the racing
+        # host thread's search — make it concede instead
+        opts["slot_overflow_fallback"] = False
         threads = [
             threading.Thread(
                 target=run, daemon=True,

@@ -459,7 +459,8 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                  chunk_entries: int = 4096,
                  budget_s: float | None = None,
                  cancel=None,
-                 explain: bool = True) -> dict:
+                 explain: bool = True,
+                 slot_overflow_fallback: bool = True) -> dict:
     """Check one history on the device. The slot count is sized to the
     history's actual peak concurrency; long histories run as a sequence
     of bounded-duration chunked kernel calls with the frontier carried
@@ -493,6 +494,11 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
         if slots <= 256:
             entries = build_entries(ops, slots)
     if slots > 256:
+        if not slot_overflow_fallback:
+            # competition racing: a parallel host thread is already
+            # running this search — don't duplicate it
+            return {"valid?": "unknown", "analyzer": "tpu-wgl",
+                    "error": f"slot overflow ({slots} slots needed)"}
         from .linear import analysis_host
         a = analysis_host(model, hist, budget_s=budget_s, cancel=cancel)
         a["analyzer"] = "host-jit-linear (slot overflow)"

@@ -11,7 +11,8 @@ import importlib
 import json
 import urllib.request
 
-SUITES = ("etcd", "zookeeper", "hazelcast", "consul")
+SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
+          "cockroach")
 
 
 def suite(name: str):

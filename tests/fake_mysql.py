@@ -1,0 +1,149 @@
+"""An in-process MySQL-protocol server backed by sql_engine, standing in
+for TiDB the way fake_etcd stands in for etcd: the suite's wire client
+(`jepsen_tpu/suites/mysql_proto.py`) is exercised against the real
+protocol framing, while the data layer stays hermetic and serializable.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from sql_engine import Engine, SQLError
+
+COM_QUIT = 0x01
+COM_QUERY = 0x03
+COM_PING = 0x0E
+
+
+def _lenenc(n: int) -> bytes:
+    if n < 0xFB:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + n.to_bytes(3, "little")
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def _lenenc_str(b: bytes) -> bytes:
+    return _lenenc(len(b)) + b
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _send(self, payload: bytes):
+        head = len(payload).to_bytes(3, "little") + bytes([self.seq])
+        self.request.sendall(head + payload)
+        self.seq = (self.seq + 1) % 256
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    def _recv(self) -> bytes:
+        head = self._recv_exact(4)
+        n = int.from_bytes(head[:3], "little")
+        self.seq = (head[3] + 1) % 256
+        return self._recv_exact(n)
+
+    def _ok(self, affected: int = 0):
+        self._send(b"\x00" + _lenenc(affected) + _lenenc(0) +
+                   struct.pack("<HH", 2, 0))
+
+    def _err(self, code: int, msg: str):
+        self._send(b"\xff" + struct.pack("<H", code) + b"#HY000" +
+                   msg.encode())
+
+    def _eof(self):
+        self._send(b"\xfe" + struct.pack("<HH", 0, 2))
+
+    def _resultset(self, rows, cols):
+        self._send(_lenenc(len(cols)))
+        for c in cols:
+            cb = c.encode()
+            self._send(_lenenc_str(b"def") + _lenenc_str(b"") +
+                       _lenenc_str(b"t") + _lenenc_str(b"t") +
+                       _lenenc_str(cb) + _lenenc_str(cb) +
+                       b"\x0c" + struct.pack("<HIBHB", 33, 255, 0xFD, 0, 0)
+                       + b"\x00\x00")
+        self._eof()
+        for row in rows:
+            out = b""
+            for v in row:
+                out += b"\xfb" if v is None else _lenenc_str(
+                    str(v).encode())
+            self._send(out)
+        self._eof()
+
+    def handle(self):
+        self.seq = 0
+        srv: "FakeMySQLServer" = self.server  # type: ignore[assignment]
+        session = srv.engine.session()
+        try:
+            # handshake v10, 20-byte salt, mysql_native_password
+            salt = b"0123456789abcdefghij"
+            greet = (b"\x0a" + b"5.7.25-TiDB-fake\0" +
+                     struct.pack("<I", 1) + salt[:8] + b"\x00" +
+                     struct.pack("<H", 0xF7FF) + b"\x21" +
+                     struct.pack("<H", 2) + struct.pack("<H", 0x000F) +
+                     bytes([21]) + b"\x00" * 10 + salt[8:] + b"\x00" +
+                     b"mysql_native_password\0")
+            self._send(greet)
+            self._recv()  # handshake response; trust any auth
+            self._ok()
+            while True:
+                pkt = self._recv()
+                self.seq = 1
+                cmd = pkt[0]
+                if cmd == COM_QUIT:
+                    return
+                if cmd == COM_PING:
+                    self._ok()
+                    continue
+                if cmd != COM_QUERY:
+                    self._err(1047, f"unknown command {cmd}")
+                    continue
+                sql = pkt[1:].decode()
+                if srv.fail_hook:
+                    errc = srv.fail_hook(sql)
+                    if errc:
+                        self._err(*errc)
+                        continue
+                try:
+                    rows, cols = session.execute(sql)
+                except SQLError as e:
+                    self._err(e.code, e.message)
+                    continue
+                if cols is None:
+                    self._ok(rows)
+                else:
+                    self._resultset(rows, cols)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            session.abort()
+
+
+class FakeMySQLServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, engine: Engine | None = None):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.engine = engine or Engine()
+        # fail_hook(sql) -> (code, msg) to inject an error, or None
+        self.fail_hook = None
+        self.port = self.server_address[1]
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()

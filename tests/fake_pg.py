@@ -1,0 +1,126 @@
+"""An in-process Postgres-protocol server backed by sql_engine, standing
+in for CockroachDB: exercises the suite's wire client
+(`jepsen_tpu/suites/pg_proto.py`) against real v3 framing with trust
+auth, hermetic serializable data layer.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from sql_engine import Engine, SQLError
+
+
+def _msg(typ: bytes, body: bytes) -> bytes:
+    return typ + struct.pack("!I", len(body) + 4) + body
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    def _error(self, code: str, msg: str):
+        body = b"SERROR\0" + b"C" + code.encode() + b"\0" + \
+            b"M" + msg.encode() + b"\0\0"
+        self.request.sendall(_msg(b"E", body))
+
+    def _ready(self, session):
+        status = b"T" if session.in_txn else b"I"
+        self.request.sendall(_msg(b"Z", status))
+
+    def _resultset(self, rows, cols):
+        body = struct.pack("!H", len(cols))
+        for c in cols:
+            body += c.encode() + b"\0" + struct.pack("!IHIHIH", 0, 0, 25,
+                                                     65535, 0, 0)
+        out = _msg(b"T", body)
+        for row in rows:
+            rb = struct.pack("!H", len(row))
+            for v in row:
+                if v is None:
+                    rb += struct.pack("!i", -1)
+                else:
+                    vb = str(v).encode()
+                    rb += struct.pack("!i", len(vb)) + vb
+            out += _msg(b"D", rb)
+        out += _msg(b"C", b"SELECT %d\0" % len(rows))
+        self.request.sendall(out)
+
+    def handle(self):
+        srv: "FakePGServer" = self.server  # type: ignore[assignment]
+        session = srv.engine.session()
+        try:
+            # startup message (possibly preceded by SSLRequest)
+            while True:
+                n = struct.unpack("!I", self._recv_exact(4))[0] - 4
+                body = self._recv_exact(n)
+                if len(body) >= 4 and \
+                        struct.unpack("!I", body[:4])[0] == 80877103:
+                    self.request.sendall(b"N")  # no SSL
+                    continue
+                break
+            self.request.sendall(_msg(b"R", struct.pack("!I", 0)))
+            self.request.sendall(
+                _msg(b"S", b"server_version\013.0-fake-cockroach\0"))
+            self.request.sendall(_msg(b"K", struct.pack("!II", 1, 2)))
+            self._ready(session)
+            while True:
+                typ = self._recv_exact(1)
+                n = struct.unpack("!I", self._recv_exact(4))[0] - 4
+                body = self._recv_exact(n)
+                if typ == b"X":
+                    return
+                if typ != b"Q":
+                    self._error("0A000", f"unsupported message {typ!r}")
+                    self._ready(session)
+                    continue
+                sql = body.rstrip(b"\0").decode()
+                if srv.fail_hook:
+                    errc = srv.fail_hook(sql)
+                    if errc:
+                        self._error(*errc)
+                        self._ready(session)
+                        continue
+                try:
+                    rows, cols = session.execute(sql)
+                except SQLError as e:
+                    self._error(str(e.code), e.message)
+                    self._ready(session)
+                    continue
+                if cols is None:
+                    tag = b"INSERT 0 %d\0" % rows if "insert" in \
+                        sql.lower()[:8] else b"OK %d\0" % rows
+                    self.request.sendall(_msg(b"C", tag))
+                else:
+                    self._resultset(rows, cols)
+                self._ready(session)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            session.abort()
+
+
+class FakePGServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, engine: Engine | None = None):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.engine = engine or Engine()
+        self.fail_hook = None  # fail_hook(sql) -> (sqlstate, msg) | None
+        self.port = self.server_address[1]
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()

@@ -1,0 +1,256 @@
+"""A tiny in-memory SQL engine backing the fake MySQL/Postgres servers.
+
+Supports exactly the statement shapes the tidb/cockroach suites issue
+(create table / insert .. on duplicate key update / upsert / select /
+update / begin / commit / rollback), with serializable semantics: a
+global lock is held from BEGIN to COMMIT, and ROLLBACK restores the
+pre-transaction snapshot. This mirrors the hermetic-fake test tier the
+reference gets from `:ssh {:dummy? true}` + in-JVM databases
+(`jepsen/src/jepsen/tests.clj:27-67`).
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+import threading
+
+
+class SQLError(Exception):
+    def __init__(self, code, message):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+_CREATE = re.compile(
+    r"create table (?:if not exists )?(\w+)\s*\((.*)\)\s*$",
+    re.I | re.S)
+_INSERT = re.compile(
+    r"(insert|upsert) into (\w+)\s*\(([^)]*)\)\s*values\s*\((.*?)\)"
+    r"(?:\s+on duplicate key update\s+(.*))?$", re.I | re.S)
+_SELECT = re.compile(
+    r"select\s+(.*?)\s+from\s+(\w+)(?:\s+where\s+(\w+)\s*=\s*(\S+))?"
+    r"(?:\s+for update)?\s*$", re.I | re.S)
+_UPDATE = re.compile(
+    r"update (\w+)\s+set\s+(.*?)\s+where\s+(\w+)\s*=\s*(\S+)\s*$",
+    re.I | re.S)
+_CONCAT = re.compile(r"concat\((.*)\)\s*$", re.I)
+# split on commas outside single-quoted strings
+_ARGSPLIT = re.compile(r",(?=(?:[^']*'[^']*')*[^']*$)")
+
+
+def _literal(tok: str):
+    tok = tok.strip().rstrip(";")
+    if tok.startswith("'") and tok.endswith("'"):
+        return tok[1:-1]
+    if tok.lstrip("-").isdigit():
+        return int(tok)
+    return tok
+
+
+class Engine:
+    """One shared database; connections are `Session`s."""
+
+    def __init__(self):
+        self.tables: dict[str, dict] = {}
+        self.lock = threading.RLock()
+
+    def session(self) -> "Session":
+        return Session(self)
+
+
+class Session:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self.in_txn = False
+        self.snapshot = None
+
+    def execute(self, sql: str):
+        """Returns (rows, cols) for selects, (affected, None) else."""
+        sql = sql.strip().rstrip(";").strip()
+        low = sql.lower()
+        if low.startswith("begin") or low.startswith("start transaction"):
+            return self._begin()
+        if low.startswith("commit"):
+            return self._commit()
+        if low.startswith("rollback"):
+            return self._rollback()
+        with self.engine.lock:
+            if low.startswith("create index"):
+                return 0, None
+            if low.startswith("drop table"):
+                name = sql.split()[-1]
+                self.engine.tables.pop(name, None)
+                return 0, None
+            m = _CREATE.match(sql)
+            if m:
+                return self._create(m)
+            m = _INSERT.match(sql)
+            if m:
+                return self._insert(m)
+            m = _SELECT.match(sql)
+            if m:
+                return self._select(m)
+            m = _UPDATE.match(sql)
+            if m:
+                return self._update(m)
+            if low.startswith("set "):
+                return 0, None
+            raise SQLError(1064, f"unsupported statement: {sql!r}")
+
+    # -- transactions ------------------------------------------------------
+
+    def _begin(self):
+        if not self.in_txn:
+            self.engine.lock.acquire()
+            self.in_txn = True
+            self.snapshot = copy.deepcopy(self.engine.tables)
+        return 0, None
+
+    def _commit(self):
+        if self.in_txn:
+            self.in_txn = False
+            self.snapshot = None
+            self.engine.lock.release()
+        return 0, None
+
+    def _rollback(self):
+        if self.in_txn:
+            self.engine.tables.clear()
+            self.engine.tables.update(self.snapshot)
+            self.in_txn = False
+            self.snapshot = None
+            self.engine.lock.release()
+        return 0, None
+
+    def abort(self):
+        """Connection dropped mid-transaction."""
+        self._rollback()
+
+    # -- statements --------------------------------------------------------
+
+    def _create(self, m):
+        name, body = m.group(1), m.group(2)
+        if name in self.engine.tables:
+            return 0, None
+        cols, pk, auto = [], None, None
+        for coldef in re.split(r",(?![^()]*\))", body):
+            coldef = coldef.strip()
+            if not coldef or coldef.lower().startswith(("primary key",
+                                                        "index", "unique")):
+                inner = re.search(r"\((\w+)\)", coldef)
+                if coldef.lower().startswith("primary key") and inner:
+                    pk = inner.group(1)
+                continue
+            cname = coldef.split()[0]
+            cols.append(cname)
+            if "primary key" in coldef.lower():
+                pk = cname
+            if "auto_increment" in coldef.lower() or \
+                    "serial" in coldef.lower():
+                auto = cname
+        self.engine.tables[name] = {
+            "cols": cols, "pk": pk, "auto": auto, "next": 1, "rows": {},
+            "seq": 0}
+        return 0, None
+
+    def _table(self, name):
+        t = self.engine.tables.get(name)
+        if t is None:
+            raise SQLError(1146, f"table {name!r} doesn't exist")
+        return t
+
+    def _insert(self, m):
+        verb, name, cols, vals, on_dup = (m.group(1).lower(), m.group(2),
+                                          m.group(3), m.group(4),
+                                          m.group(5))
+        t = self._table(name)
+        cnames = [c.strip() for c in cols.split(",")]
+        values = [_literal(v) for v in _ARGSPLIT.split(vals)]
+        row = dict(zip(cnames, values))
+        if t["auto"] and t["auto"] not in row:
+            row[t["auto"]] = t["next"]
+            t["next"] += 1
+        pk = t["pk"] or t["auto"]
+        key = row.get(pk) if pk else t["seq"]
+        t["seq"] += 1
+        if pk and key in t["rows"]:
+            if verb == "upsert":
+                t["rows"][key].update(row)
+                return 1, None
+            if on_dup:
+                existing = t["rows"][key]
+                for assign in re.split(r",(?![^()]*\))", on_dup):
+                    col, expr = assign.split("=", 1)
+                    existing[col.strip()] = self._eval(expr.strip(),
+                                                      existing)
+                return 2, None
+            raise SQLError(1062, f"duplicate entry {key!r} for "
+                                 f"primary key of {name!r}")
+        t["rows"][key] = row
+        return 1, None
+
+    def _eval(self, expr: str, row: dict):
+        mc = _CONCAT.match(expr)
+        if mc:
+            parts = []
+            for tok in _ARGSPLIT.split(mc.group(1)):
+                tok = tok.strip()
+                if re.fullmatch(r"\w+", tok) and not tok.isdigit() \
+                        and tok in row:
+                    parts.append(str(row.get(tok) or ""))
+                else:
+                    parts.append(str(_literal(tok)))
+            return "".join(parts)
+        if expr in row:
+            return row[expr]
+        return _literal(expr)
+
+    def _select(self, m):
+        cols, name, wcol, wval = (m.group(1), m.group(2), m.group(3),
+                                  m.group(4))
+        t = self._table(name)
+        rows = list(t["rows"].values())
+        if wcol:
+            wv = _literal(wval)
+            rows = [r for r in rows if r.get(wcol) == wv]
+        if cols.strip() == "*":
+            out_cols = t["cols"]
+        else:
+            out_cols = [c.strip().strip("()") for c in cols.split(",")]
+            agg = re.match(r"(max|count)\((\w+|\*)\)", out_cols[0], re.I)
+            if agg:
+                fn, col = agg.group(1).lower(), agg.group(2)
+                if fn == "count":
+                    return [[str(len(rows))]], [f"count({col})"]
+                vals = [r.get(col) for r in rows if r.get(col) is not None]
+                mx = max(vals) if vals else None
+                return [[None if mx is None else str(mx)]], [f"max({col})"]
+        out = [[None if r.get(c) is None else str(r.get(c))
+                for c in out_cols] for r in rows]
+        return out, out_cols
+
+    def _update(self, m):
+        name, assigns, wcol, wval = (m.group(1), m.group(2), m.group(3),
+                                     m.group(4))
+        t = self._table(name)
+        wv = _literal(wval)
+        n = 0
+        for r in t["rows"].values():
+            if r.get(wcol) == wv:
+                for assign in assigns.split(","):
+                    col, expr = assign.split("=", 1)
+                    col = col.strip()
+                    expr = expr.strip()
+                    marith = re.match(
+                        r"(\w+)\s*([+-])\s*(\d+)$", expr)
+                    if marith and marith.group(1) in r:
+                        base = int(r[marith.group(1)])
+                        d = int(marith.group(3))
+                        r[col] = base + d if marith.group(2) == "+" \
+                            else base - d
+                    else:
+                        r[col] = self._eval(expr, r)
+                n += 1
+        return n, None

@@ -1,0 +1,178 @@
+"""CockroachDB suite tests: DB command generation against the recording
+dummy remote, the Postgres wire client against an in-process protocol
+fake, error classification, and complete hermetic suite runs."""
+
+import pytest
+
+from fake_pg import FakePGServer
+
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import cockroach, suite
+from jepsen_tpu.suites.pg_proto import Conn, PGError
+
+
+@pytest.fixture
+def fake():
+    f = FakePGServer()
+    yield f
+    f.stop()
+
+
+def conn_fn(fake):
+    return lambda node: Conn("127.0.0.1", fake.port)
+
+
+def test_suite_registry():
+    assert suite("cockroach") is cockroach
+
+
+def test_db_setup_commands():
+    """Setup installs the tarball, starts with --insecure --join, and
+    runs `cockroach init` once on the first node (`auto.clj:60-140`)."""
+    log = []
+    remote = dummy.remote(
+        log=log, responses={r"ls -A \.": "cockroach-v2.1.6.linux-amd64"})
+    test = {"nodes": ["n1", "n2"], "tarball": "file:///tmp/crdb.tgz"}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            cockroach.db().setup(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "start --insecure" in cmds
+    assert "--join=n1:26257,n2:26257" in cmds
+    assert "init --insecure" in cmds
+    # second node must not init
+    log.clear()
+    with control.with_remote(remote):
+        sess = control.session("n2")
+        with control.with_session("n2", sess):
+            cockroach.db().setup(test, "n2")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "init --insecure" not in cmds
+
+
+def test_pg_client_roundtrip(fake):
+    c = Conn("127.0.0.1", fake.port)
+    c.query("create table if not exists t (id int primary key, val int)")
+    assert c.query("upsert into t (id, val) values (1, 5)") == (1, None)
+    rows, cols = c.query("select val from t where id = 1")
+    assert rows == [["5"]] and cols == ["val"]
+    c.query("begin")
+    assert c.txn_status == "T"
+    c.query("rollback")
+    assert c.txn_status == "I"
+    with pytest.raises(PGError):
+        c.query("bogus")
+    c.close()
+
+
+def test_wr_txn_client(fake):
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = cockroach.WrTxnClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                     "value": [["w", 1, 9], ["r", 1, None]]})
+    assert r["type"] == "ok"
+    assert r["value"] == [["w", 1, 9], ["r", 1, 9]]
+
+
+def test_serialization_conflict_is_definite_fail(fake):
+    fake.fail_hook = lambda sql: ("40001", "restart transaction") \
+        if "upsert" in sql.lower() else None
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = cockroach.WrTxnClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                     "value": [["w", 1, 9]]})
+    assert r["type"] == "fail"
+    assert r["error"][1] == "40001"
+    # unknown SQLSTATE mid-write -> info
+    fake.fail_hook = lambda sql: ("XX000", "boom") \
+        if "upsert" in sql.lower() else None
+    r2 = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                      "value": [["w", 1, 9]]})
+    assert r2["type"] == "info"
+
+
+def test_bank_client(fake):
+    t = {"sql-conn-fn": conn_fn(fake), "accounts": [0, 1],
+         "total-amount": 20}
+    c = cockroach.BankClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "read", "process": 0})
+    assert r["type"] == "ok" and sum(r["value"].values()) == 20
+    x = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                     "value": {"from": 0, "to": 1, "amount": 5}})
+    assert x["type"] == "ok"
+    bad = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                       "value": {"from": 1, "to": 0, "amount": 50}})
+    assert bad["type"] == "fail"
+
+
+def test_g2_client_blocks_second_insert(fake):
+    from jepsen_tpu.independent import ktuple
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = cockroach.G2Client().open(t, "n1")
+    c.setup(t)
+    r1 = c.invoke(t, {"type": "invoke", "f": "insert", "process": 0,
+                      "value": ktuple(3, [7, None])})
+    assert r1["type"] == "ok"
+    r2 = c.invoke(t, {"type": "invoke", "f": "insert", "process": 1,
+                      "value": ktuple(3, [None, 8])})
+    assert r2["type"] == "fail"
+
+
+def test_cockroach_test_map_builds():
+    t = cockroach.cockroach_test(
+        {"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+         "ssh": {"dummy": True}, "workload": "bank", "time-limit": 5,
+         "faults": ["none"]})
+    assert t["name"] == "cockroach-bank"
+    assert t["generator"] is not None
+
+
+def test_clock_faults_use_native_tools():
+    """The clock fault family maps to the framework clock package,
+    which compiles/drives the native C++ time tools
+    (`nemesis.clj:201-270` parity)."""
+    t = cockroach.cockroach_test(
+        {"nodes": ["n1"], "concurrency": 1, "ssh": {"dummy": True},
+         "workload": "bank", "time-limit": 1, "faults": ["clock"]})
+    from jepsen_tpu.nemesis.time import ClockNemesis
+
+    def nemeses(nem):
+        yield nem
+        for attr in ("nemeses", "pairs"):
+            for x in getattr(nem, attr, None) or []:
+                yield from nemeses(x[1] if isinstance(x, tuple) else x)
+
+    assert any(isinstance(x, ClockNemesis) for x in nemeses(t["nemesis"]))
+
+
+@pytest.mark.parametrize("workload", sorted(cockroach.WORKLOADS))
+def test_hermetic_suite_run(tmp_path, fake, workload):
+    """End to end: dummy remote for the cluster, fake Postgres-protocol
+    CockroachDB for the data plane, full checker stack. The fake is
+    serializable, so every workload must verify."""
+    opts = {
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 6,
+        "ssh": {"dummy": True},
+        "workload": workload,
+        "rate": 500,
+        "time-limit": 3,
+        "ops-per-key": 20,
+        "faults": ["none"],
+        "store-dir": str(tmp_path / "store"),
+    }
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    t = cockroach.cockroach_test(opts)
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["sql-conn-fn"] = conn_fn(fake)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert len(done["history"]) > 10

@@ -1,0 +1,205 @@
+"""TiDB suite tests: DB command generation against the recording dummy
+remote, the MySQL wire client against an in-process protocol fake, SQL
+client semantics, and complete hermetic suite runs (real wire protocol,
+real checkers)."""
+
+import pytest
+
+from fake_mysql import FakeMySQLServer
+
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import suite, tidb
+from jepsen_tpu.suites.mysql_proto import Conn, MySQLError
+
+
+@pytest.fixture
+def fake():
+    f = FakeMySQLServer()
+    yield f
+    f.stop()
+
+
+def conn_fn(fake):
+    return lambda node: Conn("127.0.0.1", fake.port)
+
+
+def test_suite_registry():
+    assert suite("tidb") is tidb
+
+
+def test_initial_cluster():
+    t = {"nodes": ["n1", "n2"]}
+    assert tidb.initial_cluster(t) == \
+        "pd1=http://n1:2380,pd2=http://n2:2380"
+    assert tidb.pd_endpoints(t) == "n1:2379,n2:2379"
+
+
+def test_db_setup_commands():
+    """Setup installs the tarball and starts pd -> tikv -> tidb in
+    order (`db.clj:102-240`)."""
+    log = []
+    remote = dummy.remote(
+        log=log, responses={r"ls -A \.": "tidb-v3.0.0-linux-amd64"})
+    test = {"nodes": ["n1"], "tarball": "file:///tmp/tidb.tgz"}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            tidb.db().setup(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "pd-server" in cmds and "tikv-server" in cmds \
+        and "tidb-server" in cmds
+    assert cmds.index("pd-server") < cmds.index("tikv-server") \
+        < cmds.index("tidb-server")
+    assert "--initial-cluster pd1=http://n1:2380" in cmds
+
+
+def test_mysql_client_roundtrip(fake):
+    c = Conn("127.0.0.1", fake.port)
+    c.query("create table if not exists t "
+            "(id int not null primary key, sk int not null, val text)")
+    assert c.query("insert into t (id, sk, val) values (1, 1, '5')") \
+        == (1, None)
+    rows, cols = c.query("select val from t where id = 1")
+    assert rows == [["5"]] and cols == ["val"]
+    with pytest.raises(MySQLError) as ei:
+        c.query("insert into t (id, sk, val) values (1, 1, 'x')")
+    assert ei.value.code == 1062
+    assert c.ping()
+    c.close()
+
+
+def test_txn_client_append_and_read(fake):
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = tidb.TxnClient().open(t, "n1")
+    c.setup(t)
+    op = {"type": "invoke", "f": "txn", "process": 0,
+          "value": [["append", 5, 1], ["r", 5, None]]}
+    r = c.invoke(t, op)
+    assert r["type"] == "ok"
+    assert r["value"] == [["append", 5, 1], ["r", 5, [1]]]
+    r2 = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                      "value": [["append", 5, 2], ["r", 5, None]]})
+    assert r2["value"][1] == ["r", 5, [1, 2]]
+    # single-mop txns skip begin/commit (txn.clj:66-72)
+    r3 = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                      "value": [["r", 5, None]]})
+    assert r3["value"] == [["r", 5, [1, 2]]]
+    c.close(t)
+
+
+def test_wr_client_reads_ints(fake):
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = tidb.WrTxnClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                     "value": [["w", 3, 7], ["r", 3, None]]})
+    assert r["type"] == "ok"
+    assert r["value"] == [["w", 3, 7], ["r", 3, 7]]
+    r2 = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                      "value": [["r", 99, None]]})
+    assert r2["value"] == [["r", 99, None]]
+    c.close(t)
+
+
+def test_txn_conflict_classified_as_fail(fake):
+    # deadlock error (1213) mid-transaction -> definite fail
+    fake.fail_hook = lambda sql: (1213, "Deadlock found") \
+        if "insert" in sql.lower() else None
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = tidb.TxnClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                     "value": [["append", 1, 1], ["r", 1, None]]})
+    assert r["type"] == "fail"
+    assert r["error"][1] == 1213
+
+
+def test_unknown_error_mid_write_is_info(fake):
+    fake.fail_hook = lambda sql: (1105, "unknown") \
+        if "insert" in sql.lower() else None
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = tidb.TxnClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                     "value": [["append", 1, 1], ["r", 1, None]]})
+    assert r["type"] == "info"
+    # but a read-only txn with the same failure is a safe fail
+    fake.fail_hook = lambda sql: (1105, "unknown") \
+        if "select" in sql.lower() else None
+    r2 = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                      "value": [["r", 1, None], ["r", 2, None]]})
+    assert r2["type"] == "fail"
+
+
+def test_bank_client(fake):
+    t = {"sql-conn-fn": conn_fn(fake), "accounts": [0, 1, 2],
+         "total-amount": 30}
+    c = tidb.BankClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, {"type": "invoke", "f": "read", "process": 0})
+    assert r["type"] == "ok" and sum(r["value"].values()) == 30
+    xfer = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                        "value": {"from": 0, "to": 1, "amount": 10}})
+    assert xfer["type"] == "ok"
+    r2 = c.invoke(t, {"type": "invoke", "f": "read", "process": 0})
+    assert r2["value"][1] == 10 and sum(r2["value"].values()) == 30
+    # overdraw fails cleanly
+    bad = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                       "value": {"from": 2, "to": 0, "amount": 99}})
+    assert bad["type"] == "fail"
+
+
+def test_register_client_cas(fake):
+    from jepsen_tpu.independent import ktuple
+    t = {"sql-conn-fn": conn_fn(fake)}
+    c = tidb.RegisterClient().open(t, "n1")
+    c.setup(t)
+    w = c.invoke(t, {"type": "invoke", "f": "write", "process": 0,
+                     "value": ktuple(1, 5)})
+    assert w["type"] == "ok"
+    r = c.invoke(t, {"type": "invoke", "f": "read", "process": 0,
+                     "value": ktuple(1, None)})
+    assert r["type"] == "ok" and r["value"].value == 5
+    ok = c.invoke(t, {"type": "invoke", "f": "cas", "process": 0,
+                      "value": ktuple(1, (5, 6))})
+    assert ok["type"] == "ok"
+    no = c.invoke(t, {"type": "invoke", "f": "cas", "process": 0,
+                      "value": ktuple(1, (5, 7))})
+    assert no["type"] == "fail"
+
+
+def test_tidb_test_map_builds():
+    t = tidb.tidb_test({"nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                        "ssh": {"dummy": True}, "workload": "append",
+                        "time-limit": 5, "faults": ["none"]})
+    assert t["name"] == "tidb-append"
+    assert t["generator"] is not None
+
+
+@pytest.mark.parametrize("workload", sorted(tidb.WORKLOADS))
+def test_hermetic_suite_run(tmp_path, fake, workload):
+    """The whole suite end to end: dummy remote for the cluster, fake
+    MySQL-protocol TiDB for the data plane, full checker stack. The
+    fake is serializable, so every workload must verify."""
+    opts = {
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 6,
+        "ssh": {"dummy": True},
+        "workload": workload,
+        "rate": 500,
+        "time-limit": 3,
+        "ops-per-key": 20,
+        "faults": ["none"],
+        "store-dir": str(tmp_path / "store"),
+    }
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    t = tidb.tidb_test(opts)
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["sql-conn-fn"] = conn_fn(fake)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert len(done["history"]) > 10
