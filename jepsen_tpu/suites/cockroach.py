@@ -28,13 +28,11 @@ import logging
 from .. import checker, cli, client as jclient, control
 from .. import db as jdb
 from .. import generator as gen
-from .. import independent, testkit
-from ..checker import timeline
+from .. import independent
 from ..control import util as cu
-from ..nemesis import combined
-from ..os_ import debian
 from ..workloads import adya as adya_w, bank as bank_w, \
     linearizable_register, wr as wr_w
+from . import std_opts, std_test
 from .pg_proto import Conn, PGError
 
 log = logging.getLogger(__name__)
@@ -441,66 +439,16 @@ WORKLOADS = {
 
 def cockroach_test(opts: dict) -> dict:
     workload_name = opts.get("workload", "bank")
-    workload = WORKLOADS[workload_name](opts)
-    the_db = db(opts.get("version", DEFAULT_VERSION))
-    faults = opts.get("faults") or ["partition"]
-    faults = [f for f in faults if f != "none"]
-    pkg = combined.nemesis_package({
-        "db": the_db, "faults": faults,
-        "interval": opts.get("nemesis-interval", 10)}) \
-        if faults else combined.noop
-
-    rate = float(opts.get("rate", 10))
-    time_limit = opts.get("time-limit", opts.get("time_limit", 60))
-    client_gen = gen.clients(gen.stagger(1 / rate,
-                                         workload["generator"]))
-    main_gen = gen.time_limit(
-        time_limit,
-        gen.any(client_gen, gen.nemesis(pkg["generator"]))
-        if pkg.get("generator") else client_gen)
-    phases = [main_gen]
-    if pkg.get("final-generator"):
-        phases.append(gen.nemesis(pkg["final-generator"]))
-    final = workload.get("final-generator")
-    if final:
-        phases.append(gen.clients(final))
-    generator = gen.phases(*phases) if len(phases) > 1 else main_gen
-
-    return {
-        **testkit.noop_test(),
-        **{k: v for k, v in opts.items() if isinstance(k, str)},
-        "name": f"cockroach-{workload_name}",
-        "os": debian.os,
-        "db": the_db,
-        "client": workload["client"],
-        "nemesis": pkg["nemesis"],
-        "plot": {"nemeses": pkg.get("perf")},
-        "generator": generator,
-        "checker": checker.compose({
-            "perf": checker.perf_checker(),
-            "timeline": timeline.html(),
-            "workload": workload["checker"],
-            "stats": checker.stats(),
-            "exceptions": checker.unhandled_exceptions(),
-        }),
-    }
+    return std_test(
+        opts, name=f"cockroach-{workload_name}",
+        db=db(opts.get("version", DEFAULT_VERSION)),
+        workload=WORKLOADS[workload_name](opts))
 
 
-OPT_SPEC = [
-    cli.opt("--workload", "-w", default="bank",
-            choices=sorted(WORKLOADS), help="Which workload to run"),
-    cli.opt("--version", default=DEFAULT_VERSION,
-            help="CockroachDB version to install"),
-    cli.opt("--rate", type=float, default=10,
-            help="approximate op rate per second"),
+OPT_SPEC = std_opts(cli, WORKLOADS, "bank", DEFAULT_VERSION,
+                    "CockroachDB version to install") + [
     cli.opt("--ops-per-key", type=int, default=100,
             help="ops per independent key (register workload)"),
-    cli.opt("--faults", action="append",
-            choices=["partition", "kill", "pause", "clock", "none"],
-            help="faults to inject (repeatable; clock drives the "
-                 "native bump/strobe/adjtime tools)"),
-    cli.opt("--nemesis-interval", type=float, default=10,
-            help="seconds between nemesis operations"),
 ]
 
 

@@ -22,13 +22,11 @@ import logging
 from .. import checker, cli, client as jclient, control
 from .. import db as jdb
 from .. import generator as gen
-from .. import independent, testkit
-from ..checker import timeline
+from .. import independent
 from ..control import util as cu
-from ..nemesis import combined
-from ..os_ import debian
 from ..workloads import append as append_w, bank as bank_w, \
     linearizable_register, long_fork as long_fork_w, wr as wr_w
+from . import std_opts, std_test
 from .mysql_proto import Conn, MySQLError
 
 log = logging.getLogger(__name__)
@@ -513,65 +511,16 @@ def tidb_test(opts: dict) -> dict:
     """Build the test map from CLI options (`core.clj` + `run.sh`
     shape): workload menu x nemesis package."""
     workload_name = opts.get("workload", "append")
-    workload = WORKLOADS[workload_name](opts)
-    the_db = db(opts.get("version", DEFAULT_VERSION))
-    faults = opts.get("faults") or ["partition"]
-    faults = [f for f in faults if f != "none"]
-    pkg = combined.nemesis_package({
-        "db": the_db, "faults": faults,
-        "interval": opts.get("nemesis-interval", 10)}) \
-        if faults else combined.noop
-
-    rate = float(opts.get("rate", 10))
-    time_limit = opts.get("time-limit", opts.get("time_limit", 60))
-    client_gen = gen.clients(gen.stagger(1 / rate,
-                                         workload["generator"]))
-    main_gen = gen.time_limit(
-        time_limit,
-        gen.any(client_gen, gen.nemesis(pkg["generator"]))
-        if pkg.get("generator") else client_gen)
-    phases = [main_gen]
-    if pkg.get("final-generator"):
-        phases.append(gen.nemesis(pkg["final-generator"]))
-    final = workload.get("final-generator")
-    if final:
-        phases.append(gen.clients(final))
-    generator = gen.phases(*phases) if len(phases) > 1 else main_gen
-
-    return {
-        **testkit.noop_test(),
-        **{k: v for k, v in opts.items() if isinstance(k, str)},
-        "name": f"tidb-{workload_name}",
-        "os": debian.os,
-        "db": the_db,
-        "client": workload["client"],
-        "nemesis": pkg["nemesis"],
-        "plot": {"nemeses": pkg.get("perf")},
-        "generator": generator,
-        "checker": checker.compose({
-            "perf": checker.perf_checker(),
-            "timeline": timeline.html(),
-            "workload": workload["checker"],
-            "stats": checker.stats(),
-            "exceptions": checker.unhandled_exceptions(),
-        }),
-    }
+    return std_test(
+        opts, name=f"tidb-{workload_name}",
+        db=db(opts.get("version", DEFAULT_VERSION)),
+        workload=WORKLOADS[workload_name](opts))
 
 
-OPT_SPEC = [
-    cli.opt("--workload", "-w", default="append",
-            choices=sorted(WORKLOADS), help="Which workload to run"),
-    cli.opt("--version", default=DEFAULT_VERSION,
-            help="TiDB version to install"),
-    cli.opt("--rate", type=float, default=10,
-            help="approximate op rate per second"),
+OPT_SPEC = std_opts(cli, WORKLOADS, "append", DEFAULT_VERSION,
+                    "TiDB version to install") + [
     cli.opt("--ops-per-key", type=int, default=100,
             help="ops per independent key (register workload)"),
-    cli.opt("--faults", action="append",
-            choices=["partition", "kill", "pause", "clock", "none"],
-            help="faults to inject (repeatable)"),
-    cli.opt("--nemesis-interval", type=float, default=10,
-            help="seconds between nemesis operations"),
 ]
 
 

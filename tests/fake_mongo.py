@@ -1,0 +1,136 @@
+"""An in-process fake mongod speaking OP_MSG/BSON, implementing the
+commands the mongodb suite's client issues (find, update with upsert,
+findAndModify, insert, replSetInitiate), backed by in-memory
+collections with a global lock."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+import sys
+import os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from jepsen_tpu.suites.bson_proto import decode_doc, encode_doc  # noqa: E402
+
+OP_MSG = 2013
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    def handle(self):
+        srv: "FakeMongo" = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                header = self._read_exact(16)
+                length, rid, _rto, opcode = struct.unpack("<iiii",
+                                                          header)
+                payload = self._read_exact(length - 16)
+                if opcode != OP_MSG:
+                    return
+                cmd = decode_doc(payload[5:])
+                if srv.fail_hook:
+                    err = srv.fail_hook(cmd)
+                    if err:
+                        reply = {"ok": 0, "code": err[0],
+                                 "errmsg": err[1]}
+                    else:
+                        reply = srv.dispatch(cmd)
+                else:
+                    reply = srv.dispatch(cmd)
+                body = struct.pack("<I", 0) + b"\x00" + encode_doc(reply)
+                self.request.sendall(
+                    struct.pack("<iiii", 16 + len(body), 1, rid,
+                                OP_MSG) + body)
+        except (ConnectionError, OSError):
+            pass
+
+
+class FakeMongo(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.colls: dict = {}
+        self.lock = threading.Lock()
+        self.fail_hook = None  # fail_hook(cmd) -> (code, msg) | None
+        self.initiated = False
+        self.port = self.server_address[1]
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+    def _coll(self, cmd, name) -> list:
+        return self.colls.setdefault((cmd["$db"], name), [])
+
+    @staticmethod
+    def _matches(doc, query) -> bool:
+        return all(doc.get(k) == v for k, v in query.items())
+
+    def dispatch(self, cmd: dict) -> dict:
+        with self.lock:
+            if "replSetInitiate" in cmd:
+                if self.initiated:
+                    return {"ok": 0, "code": 23,
+                            "errmsg": "already initialized"}
+                self.initiated = True
+                return {"ok": 1}
+            if "hello" in cmd or "ping" in cmd or "isMaster" in cmd:
+                return {"ok": 1, "isWritablePrimary": True}
+            if "find" in cmd:
+                coll = self._coll(cmd, cmd["find"])
+                docs = [d for d in coll
+                        if self._matches(d, cmd.get("filter") or {})]
+                if cmd.get("limit"):
+                    docs = docs[:cmd["limit"]]
+                return {"ok": 1, "cursor": {"id": 0, "firstBatch": docs,
+                                            "ns": "jepsen"}}
+            if "insert" in cmd:
+                coll = self._coll(cmd, cmd["insert"])
+                coll.extend(cmd["documents"])
+                return {"ok": 1, "n": len(cmd["documents"])}
+            if "findAndModify" in cmd:  # before 'update': fAM carries
+                # an 'update' field of its own
+                coll = self._coll(cmd, cmd["findAndModify"])
+                hit = [d for d in coll
+                       if self._matches(d, cmd.get("query") or {})]
+                if hit:
+                    hit[0].update(cmd["update"].get("$set", {}))
+                    return {"ok": 1, "value": hit[0],
+                            "lastErrorObject":
+                                {"updatedExisting": True, "n": 1}}
+                return {"ok": 1, "value": None,
+                        "lastErrorObject":
+                            {"updatedExisting": False, "n": 0}}
+            if "update" in cmd:
+                coll = self._coll(cmd, cmd["update"])
+                n = 0
+                for u in cmd["updates"]:
+                    hit = [d for d in coll if self._matches(d, u["q"])]
+                    if hit:
+                        for d in hit:
+                            d.update(u["u"].get("$set", {}))
+                            n += 1
+                    elif u.get("upsert"):
+                        doc = dict(u["q"])
+                        doc.update(u["u"].get("$set", {}))
+                        coll.append(doc)
+                        n += 1
+                return {"ok": 1, "n": n}
+        return {"ok": 0, "code": 59,
+                "errmsg": f"no such command: {next(iter(cmd))}"}

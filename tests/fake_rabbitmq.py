@@ -1,0 +1,81 @@
+"""An in-process fake of RabbitMQ's management HTTP API (the slice the
+rabbitmq suite's client uses: queue declare, publish, get with
+ack_requeue_false), backed by in-memory queues."""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def do_PUT(self):
+        srv: "FakeRabbitMQ" = self.server  # type: ignore[assignment]
+        parts = self.path.strip("/").split("/")
+        if parts[:2] == ["api", "queues"] and len(parts) == 4:
+            with srv.lock:
+                srv.queues.setdefault(parts[3], collections.deque())
+            return self._json(201, {})
+        self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        srv: "FakeRabbitMQ" = self.server  # type: ignore[assignment]
+        body = self._body()
+        if srv.fail_hook:
+            err = srv.fail_hook(self.path, body)
+            if err:
+                return self._json(500, {"error": err})
+        parts = self.path.strip("/").split("/")
+        if "publish" in parts:
+            q = body["routing_key"]
+            with srv.lock:
+                srv.queues.setdefault(
+                    q, collections.deque()).append(body["payload"])
+            return self._json(200, {"routed": True})
+        if parts[-1] == "get":
+            q = parts[3]
+            out = []
+            with srv.lock:
+                dq = srv.queues.setdefault(q, collections.deque())
+                for _ in range(body.get("count", 1)):
+                    if not dq:
+                        break
+                    out.append({"payload": dq.popleft(),
+                                "payload_encoding": "string"})
+            return self._json(200, out)
+        self._json(404, {"error": "not found"})
+
+
+class FakeRabbitMQ(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.queues: dict = {}
+        self.lock = threading.Lock()
+        self.fail_hook = None  # fail_hook(path, body) -> err str | None
+        self.port = self.server_address[1]
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()

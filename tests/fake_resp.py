@@ -1,0 +1,135 @@
+"""In-process RESP-protocol servers: a fake Disque (ADDJOB/GETJOB/
+ACKJOB) and a fake Redis-like register (GET/SET), standing in for the
+real systems in hermetic suite tests, the reference's dummy tier."""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import socketserver
+import threading
+
+
+def _encode(v) -> bytes:
+    if v is None:
+        return b"$-1\r\n"
+    if isinstance(v, int):
+        return b":%d\r\n" % v
+    if isinstance(v, Exception):
+        return b"-ERR %s\r\n" % str(v).encode()
+    if isinstance(v, (list, tuple)):
+        return b"*%d\r\n" % len(v) + b"".join(_encode(x) for x in v)
+    b = v if isinstance(v, bytes) else str(v).encode()
+    return b"$%d\r\n%s\r\n" % (len(b), b)
+
+
+class _RESPHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        buf = b""
+        while True:
+            while b"\r\n" not in buf:
+                chunk = self.request.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            # parse an array of bulk strings
+            try:
+                line, buf = buf.split(b"\r\n", 1)
+                n = int(line[1:])
+                args = []
+                for _ in range(n):
+                    while b"\r\n" not in buf:
+                        buf += self.request.recv(65536)
+                    ln, buf = buf.split(b"\r\n", 1)
+                    size = int(ln[1:])
+                    while len(buf) < size + 2:
+                        buf += self.request.recv(65536)
+                    args.append(buf[:size].decode())
+                    buf = buf[size + 2:]
+            except (ValueError, IndexError):
+                return
+            srv = self.server
+            if srv.fail_hook:
+                err = srv.fail_hook(args)
+                if err:
+                    self.request.sendall(b"-ERR %s\r\n" % err.encode())
+                    continue
+            reply = srv.dispatch(args)
+            self.request.sendall(_encode(reply))
+
+
+class _Base(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _RESPHandler)
+        self.port = self.server_address[1]
+        self.fail_hook = None  # fail_hook(args) -> error str | None
+        self.lock = threading.Lock()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+
+
+class FakeDisque(_Base):
+    """ADDJOB queue body timeout [...] / GETJOB ... FROM q / ACKJOB id."""
+
+    def __init__(self):
+        self.queues: dict = collections.defaultdict(collections.deque)
+        self.unacked: dict = {}
+        self.ids = itertools.count(1)
+        super().__init__()
+
+    def dispatch(self, args):
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == "ADDJOB":
+                q, body = args[1], args[2]
+                jid = f"D-{next(self.ids):08x}"
+                self.queues[q].append((jid, body))
+                return jid
+            if cmd == "GETJOB":
+                # GETJOB TIMEOUT ms COUNT n FROM q...
+                qs = args[args.index("FROM") + 1:]
+                for q in qs:
+                    if self.queues[q]:
+                        jid, body = self.queues[q].popleft()
+                        self.unacked[jid] = (q, body)
+                        return [[q, jid, body]]
+                return None
+            if cmd == "ACKJOB":
+                self.unacked.pop(args[1], None)
+                return 1
+            if cmd == "CLUSTER":
+                return "OK"
+        return Exception(f"unknown command {cmd}")
+
+    def requeue_unacked(self):
+        """Simulate retry delivery of every un-acked job."""
+        with self.lock:
+            for jid, (q, body) in self.unacked.items():
+                self.queues[q].append((jid, body))
+            self.unacked.clear()
+
+
+class FakeRedis(_Base):
+    """GET/SET register (raftis-style)."""
+
+    def __init__(self):
+        self.data: dict = {}
+        super().__init__()
+
+    def dispatch(self, args):
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == "GET":
+                return self.data.get(args[1])
+            if cmd == "SET":
+                self.data[args[1]] = args[2]
+                return "OK"
+        return Exception(f"unknown command {cmd}")

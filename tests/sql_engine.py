@@ -28,7 +28,9 @@ _CREATE = re.compile(
     re.I | re.S)
 _INSERT = re.compile(
     r"(insert|upsert) into (\w+)\s*\(([^)]*)\)\s*values\s*\((.*?)\)"
-    r"(?:\s+on duplicate key update\s+(.*))?$", re.I | re.S)
+    r"(?:\s+on duplicate key update\s+(.*)"
+    r"|\s+on conflict\s*\([^)]*\)\s+do update set\s+(.*))?$",
+    re.I | re.S)
 _SELECT = re.compile(
     r"select\s+(.*?)\s+from\s+(\w+)(?:\s+where\s+(\w+)\s*=\s*(\S+))?"
     r"(?:\s+for update)?\s*$", re.I | re.S)
@@ -162,9 +164,9 @@ class Session:
         return t
 
     def _insert(self, m):
-        verb, name, cols, vals, on_dup = (m.group(1).lower(), m.group(2),
-                                          m.group(3), m.group(4),
-                                          m.group(5))
+        verb, name, cols, vals = (m.group(1).lower(), m.group(2),
+                                  m.group(3), m.group(4))
+        on_dup = m.group(5) or m.group(6)  # mysql / postgres spellings
         t = self._table(name)
         cnames = [c.strip() for c in cols.split(",")]
         values = [_literal(v) for v in _ARGSPLIT.split(vals)]

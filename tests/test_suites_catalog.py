@@ -1,0 +1,389 @@
+"""Catalog suites: disque, raftis, rabbitmq, galera, percona, stolon,
+postgres-rds — client semantics against wire-protocol fakes, DB command
+generation against the recording dummy remote, and hermetic end-to-end
+runs through core.run for each suite's signature workload."""
+
+import pytest
+
+from fake_mysql import FakeMySQLServer
+from fake_pg import FakePGServer
+from fake_rabbitmq import FakeRabbitMQ
+from fake_resp import FakeDisque, FakeRedis
+
+import jepsen_tpu.db
+import jepsen_tpu.os_
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import (disque, galera, percona, postgres_rds,
+                               rabbitmq, raftis, stolon, suite)
+from jepsen_tpu.suites.mysql_proto import Conn as MyConn
+from jepsen_tpu.suites.pg_proto import Conn as PgConn
+from jepsen_tpu.suites.resp_proto import Conn as RespConn
+
+
+def test_suite_registry():
+    assert suite("disque") is disque
+    assert suite("raftis") is raftis
+    assert suite("rabbitmq") is rabbitmq
+    assert suite("galera") is galera
+    assert suite("percona") is percona
+    assert suite("stolon") is stolon
+    assert suite("postgres-rds") is postgres_rds
+
+
+def _hermetic(test_map, conn_key, conn_fn, tmp_path):
+    test_map["db"] = jepsen_tpu.db.noop
+    test_map["os"] = jepsen_tpu.os_.noop
+    test_map[conn_key] = conn_fn
+    test_map["store-dir"] = str(tmp_path / "store")
+    return core.run(test_map)
+
+
+# -- disque ------------------------------------------------------------------
+
+def test_disque_queue_client():
+    f = FakeDisque()
+    try:
+        t = {"resp-conn-fn": lambda n: RespConn("127.0.0.1", f.port)}
+        c = disque.QueueClient().open(t, "n1")
+        assert c.invoke(t, {"type": "invoke", "f": "enqueue",
+                            "value": 7, "process": 0})["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "dequeue",
+                         "value": None, "process": 0})
+        assert r["type"] == "ok" and r["value"] == 7
+        r2 = c.invoke(t, {"type": "invoke", "f": "dequeue",
+                          "value": None, "process": 0})
+        assert r2["type"] == "fail"
+        c.invoke(t, {"type": "invoke", "f": "enqueue", "value": 8,
+                     "process": 0})
+        d = c.invoke(t, {"type": "invoke", "f": "drain", "value": None,
+                         "process": 0})
+        assert d["type"] == "ok" and d["value"] == [8]
+        c.close(t)
+    finally:
+        f.stop()
+
+
+def test_disque_hermetic_run(tmp_path):
+    f = FakeDisque()
+    try:
+        t = disque.disque_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+            "ssh": {"dummy": True}, "rate": 300, "time-limit": 3,
+            "faults": ["none"]})
+        done = _hermetic(t, "resp-conn-fn",
+                         lambda n: RespConn("127.0.0.1", f.port),
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+# -- raftis ------------------------------------------------------------------
+
+def test_raftis_register_client():
+    f = FakeRedis()
+    try:
+        t = {"resp-conn-fn": lambda n: RespConn("127.0.0.1", f.port)}
+        c = raftis.RegisterClient().open(t, "n1")
+        r0 = c.invoke(t, {"type": "invoke", "f": "read", "value": None,
+                          "process": 0})
+        assert r0["type"] == "ok" and r0["value"] is None
+        assert c.invoke(t, {"type": "invoke", "f": "write", "value": 3,
+                            "process": 0})["type"] == "ok"
+        r1 = c.invoke(t, {"type": "invoke", "f": "read", "value": None,
+                          "process": 0})
+        assert r1["value"] == 3
+        c.close(t)
+    finally:
+        f.stop()
+
+
+def test_raftis_no_leader_is_definite_fail():
+    f = FakeRedis()
+    f.fail_hook = lambda args: \
+        "write InComplete: no leader node!" if args[0] == "SET" else None
+    try:
+        t = {"resp-conn-fn": lambda n: RespConn("127.0.0.1", f.port)}
+        c = raftis.RegisterClient().open(t, "n1")
+        r = c.invoke(t, {"type": "invoke", "f": "write", "value": 1,
+                         "process": 0})
+        assert r["type"] == "fail"
+        c.close(t)
+    finally:
+        f.stop()
+
+
+def test_raftis_hermetic_run(tmp_path):
+    f = FakeRedis()
+    try:
+        t = raftis.raftis_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"]})
+        done = _hermetic(t, "resp-conn-fn",
+                         lambda n: RespConn("127.0.0.1", f.port),
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+# -- rabbitmq ----------------------------------------------------------------
+
+def test_rabbitmq_queue_client():
+    f = FakeRabbitMQ()
+    try:
+        t = {"mgmt-url-fn": lambda n: f"http://127.0.0.1:{f.port}"}
+        c = rabbitmq.QueueClient().open(t, "n1")
+        c.setup(t)
+        assert c.invoke(t, {"type": "invoke", "f": "enqueue",
+                            "value": 5, "process": 0})["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "dequeue",
+                         "value": None, "process": 0})
+        assert r["type"] == "ok" and r["value"] == 5
+        assert c.invoke(t, {"type": "invoke", "f": "dequeue",
+                            "value": None,
+                            "process": 0})["type"] == "fail"
+    finally:
+        f.stop()
+
+
+def test_rabbitmq_mutex_client():
+    f = FakeRabbitMQ()
+    try:
+        t = {"mgmt-url-fn": lambda n: f"http://127.0.0.1:{f.port}"}
+        c = rabbitmq.MutexClient().open(t, "n1")
+        c.setup(t)
+        # token seeded once: acquire wins, second acquire fails
+        a1 = c.invoke(t, {"type": "invoke", "f": "acquire",
+                          "process": 0})
+        assert a1["type"] == "ok"
+        c2 = rabbitmq.MutexClient().open(t, "n1")
+        a2 = c2.invoke(t, {"type": "invoke", "f": "acquire",
+                           "process": 1})
+        assert a2["type"] == "fail"
+        # release without holding mints nothing
+        r2 = c2.invoke(t, {"type": "invoke", "f": "release",
+                           "process": 1})
+        assert r2["type"] == "fail"
+        assert c.invoke(t, {"type": "invoke", "f": "release",
+                            "process": 0})["type"] == "ok"
+        assert c2.invoke(t, {"type": "invoke", "f": "acquire",
+                             "process": 1})["type"] == "ok"
+    finally:
+        f.stop()
+
+
+@pytest.mark.parametrize("workload", sorted(rabbitmq.WORKLOADS))
+def test_rabbitmq_hermetic_run(tmp_path, workload):
+    f = FakeRabbitMQ()
+    try:
+        t = rabbitmq.rabbitmq_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "workload": workload, "rate": 100,
+            "time-limit": 3, "faults": ["none"]})
+        done = _hermetic(t, "mgmt-url-fn",
+                         lambda n: f"http://127.0.0.1:{f.port}",
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+# -- galera / percona --------------------------------------------------------
+
+def test_galera_dirty_reads_client_and_checker():
+    f = FakeMySQLServer()
+    try:
+        t = {"sql-conn-fn": lambda n: MyConn("127.0.0.1", f.port)}
+        c = galera.DirtyReadsClient(3).open(t, "n1")
+        c.setup(t)
+        w = c.invoke(t, {"type": "invoke", "f": "write", "value": 42,
+                         "process": 0})
+        assert w["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "read", "value": None,
+                         "process": 0})
+        assert r["type"] == "ok" and r["value"] == [42, 42, 42]
+        # checker: a failed write visible in a read is dirty
+        from jepsen_tpu.history import history
+        h = history([
+            {"type": "invoke", "f": "write", "value": 9, "process": 0,
+             "time": 0},
+            {"type": "fail", "f": "write", "value": 9, "process": 0,
+             "time": 1},
+            {"type": "invoke", "f": "read", "value": None, "process": 1,
+             "time": 2},
+            {"type": "ok", "f": "read", "value": [9, 9, 9], "process": 1,
+             "time": 3},
+        ])
+        res = galera.DirtyReadsChecker().check({}, h, {})
+        assert res["valid?"] is False and res["dirty-reads"]
+    finally:
+        f.stop()
+
+
+def test_percona_shares_galera_workloads():
+    assert percona.WORKLOADS is galera.WORKLOADS
+    t = percona.percona_test({
+        "nodes": ["n1"], "concurrency": 1, "ssh": {"dummy": True},
+        "time-limit": 1, "faults": ["none"]})
+    assert t["name"] == "percona-dirty-reads"
+
+
+@pytest.mark.parametrize("workload", sorted(galera.WORKLOADS))
+def test_galera_hermetic_run(tmp_path, workload):
+    f = FakeMySQLServer()
+    try:
+        t = galera.galera_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "workload": workload, "rate": 100,
+            "time-limit": 3, "faults": ["none"]})
+        done = _hermetic(t, "sql-conn-fn",
+                         lambda n: MyConn("127.0.0.1", f.port),
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+# -- stolon / postgres-rds ---------------------------------------------------
+
+def test_stolon_append_client():
+    f = FakePGServer()
+    try:
+        t = {"sql-conn-fn": lambda n: PgConn("127.0.0.1", f.port)}
+        c = stolon.AppendClient().open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                         "value": [["append", 1, 1], ["r", 1, None]]})
+        assert r["type"] == "ok"
+        assert r["value"] == [["append", 1, 1], ["r", 1, [1]]]
+        r2 = c.invoke(t, {"type": "invoke", "f": "txn", "process": 0,
+                          "value": [["append", 1, 2], ["r", 1, None]]})
+        assert r2["value"][1] == ["r", 1, [1, 2]]
+    finally:
+        f.stop()
+
+
+def test_stolon_db_commands():
+    log = []
+    remote = dummy.remote(
+        log=log, responses={r"ls -A \.": "stolon-v0.16.0-linux-amd64"})
+    test = {"nodes": ["n1", "n2"], "tarball": "file:///tmp/stolon.tgz"}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            stolon.db().setup(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "stolonctl init" in cmds          # first node inits
+    assert "stolon-sentinel" in cmds and "stolon-keeper" in cmds \
+        and "stolon-proxy" in cmds
+    assert "--store-endpoints http://n1:2379,http://n2:2379" in cmds
+
+
+@pytest.mark.parametrize("workload", sorted(stolon.WORKLOADS))
+def test_stolon_hermetic_run(tmp_path, workload):
+    f = FakePGServer()
+    try:
+        t = stolon.stolon_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "workload": workload, "rate": 100,
+            "time-limit": 3, "faults": ["none"]})
+        done = _hermetic(t, "sql-conn-fn",
+                         lambda n: PgConn("127.0.0.1", f.port),
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+def test_postgres_rds_hermetic_run(tmp_path):
+    f = FakePGServer()
+    try:
+        t = postgres_rds.postgres_rds_test({
+            "nodes": ["n1"], "concurrency": 3, "ssh": {"dummy": True},
+            "rate": 100, "time-limit": 3})
+        done = _hermetic(t, "sql-conn-fn",
+                         lambda n: PgConn("127.0.0.1", f.port),
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+# -- mongodb -----------------------------------------------------------------
+
+def test_mongodb_document_cas_client():
+    from fake_mongo import FakeMongo
+    from jepsen_tpu.suites import mongodb
+    from jepsen_tpu.suites.bson_proto import Conn as MongoConn
+    from jepsen_tpu.independent import ktuple
+
+    f = FakeMongo()
+    try:
+        t = {"mongo-conn-fn": lambda n: MongoConn("127.0.0.1", f.port)}
+        c = mongodb.DocumentCASClient().open(t, "n1")
+        r0 = c.invoke(t, {"type": "invoke", "f": "read", "process": 0,
+                          "value": ktuple(1, None)})
+        assert r0["type"] == "ok" and r0["value"].value is None
+        w = c.invoke(t, {"type": "invoke", "f": "write", "process": 0,
+                         "value": ktuple(1, 5)})
+        assert w["type"] == "ok"
+        r1 = c.invoke(t, {"type": "invoke", "f": "read", "process": 0,
+                          "value": ktuple(1, None)})
+        assert r1["value"].value == 5
+        ok = c.invoke(t, {"type": "invoke", "f": "cas", "process": 0,
+                          "value": ktuple(1, (5, 6))})
+        assert ok["type"] == "ok"
+        no = c.invoke(t, {"type": "invoke", "f": "cas", "process": 0,
+                          "value": ktuple(1, (5, 7))})
+        assert no["type"] == "fail"
+        c.close(t)
+    finally:
+        f.stop()
+
+
+def test_mongodb_error_classification():
+    from fake_mongo import FakeMongo
+    from jepsen_tpu.suites import mongodb
+    from jepsen_tpu.suites.bson_proto import Conn as MongoConn
+    from jepsen_tpu.independent import ktuple
+
+    f = FakeMongo()
+    f.fail_hook = lambda cmd: (10107, "not primary") \
+        if "update" in cmd else None
+    try:
+        t = {"mongo-conn-fn": lambda n: MongoConn("127.0.0.1", f.port)}
+        c = mongodb.DocumentCASClient().open(t, "n1")
+        w = c.invoke(t, {"type": "invoke", "f": "write", "process": 0,
+                         "value": ktuple(1, 5)})
+        assert w["type"] == "fail"  # NotWritablePrimary: never applied
+        f.fail_hook = lambda cmd: (9001, "mystery") \
+            if "update" in cmd else None
+        w2 = c.invoke(t, {"type": "invoke", "f": "write", "process": 0,
+                          "value": ktuple(1, 5)})
+        assert w2["type"] == "info"  # unknown error: indeterminate
+        c.close(t)
+    finally:
+        f.stop()
+
+
+@pytest.mark.parametrize("workload", ["register", "set"])
+def test_mongodb_hermetic_run(tmp_path, workload):
+    from fake_mongo import FakeMongo
+    from jepsen_tpu.suites import mongodb
+    from jepsen_tpu.suites.bson_proto import Conn as MongoConn
+
+    f = FakeMongo()
+    try:
+        t = mongodb.mongodb_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+            "ssh": {"dummy": True}, "workload": workload, "rate": 200,
+            "time-limit": 3, "ops-per-key": 20, "faults": ["none"]})
+        done = _hermetic(t, "mongo-conn-fn",
+                         lambda n: MongoConn("127.0.0.1", f.port),
+                         tmp_path)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
