@@ -24,6 +24,12 @@ class MongoError(Exception):
         self.message = message
 
 
+class WriteConcernError(MongoError):
+    """The write applied locally but the requested write concern was
+    not satisfied — durability is unknown, so callers must record the
+    op as :info (indeterminate), never :fail."""
+
+
 # -- BSON --------------------------------------------------------------------
 
 def _encode_value(name: bytes, v) -> bytes:
@@ -139,6 +145,18 @@ class Conn:
         if not reply.get("ok"):
             raise MongoError(reply.get("code", -1),
                              reply.get("errmsg", "command failed"))
+        # MongoDB reports per-document write failures and unsatisfied
+        # write concern on ok:1 replies — surface them, or callers
+        # would record failed / non-majority-durable writes as :ok.
+        if reply.get("writeErrors"):
+            we = reply["writeErrors"][0]
+            raise MongoError(we.get("code", -1),
+                             we.get("errmsg", "write error"))
+        if reply.get("writeConcernError"):
+            wce = reply["writeConcernError"]
+            raise WriteConcernError(wce.get("code", -1),
+                                    wce.get("errmsg",
+                                            "write concern error"))
         return reply
 
     def close(self) -> None:

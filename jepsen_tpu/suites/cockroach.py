@@ -50,7 +50,9 @@ DEFAULT_VERSION = "2.1.6"
 
 # SQLSTATEs that mean the txn definitely rolled back: serialization
 # conflicts CockroachDB asks clients to retry (`client.clj:150-210`).
-DEFINITE_ABORT = {"40001", "40P01", "40003"}
+# 40003 (statement_completion_unknown / "result is ambiguous") is NOT
+# here: the commit may have applied, so it must classify as :info.
+DEFINITE_ABORT = {"40001", "40P01"}
 
 
 def tarball_url(version: str) -> str:

@@ -25,7 +25,7 @@ from ..control import util as cu
 from ..os_ import debian
 from ..workloads import linearizable_register
 from . import std_opts, std_test
-from .bson_proto import Conn, MongoError
+from .bson_proto import Conn, MongoError, WriteConcernError
 
 log = logging.getLogger(__name__)
 
@@ -205,6 +205,10 @@ class DocumentCASClient(jclient.Client):
                            {}).get("updatedExisting", False)
                 return {**op, "type": "ok" if ok else "fail"}
             raise ValueError(f"unknown f {op['f']!r}")
+        except WriteConcernError as e:
+            # applied locally, durability unknown: always :info
+            return {**op, "type": "info",
+                    "error": ["mongo-write-concern", e.code, str(e)]}
         except MongoError as e:
             definite = op["f"] == "read" or e.code in DEFINITE_FAIL
             return {**op, "type": "fail" if definite else "info",
@@ -254,6 +258,10 @@ class SetClient(jclient.Client):
                               r.get("cursor", {}).get("firstBatch", []))
                 return {**op, "type": "ok", "value": vals}
             raise ValueError(f"unknown f {op['f']!r}")
+        except WriteConcernError as e:
+            # applied locally, durability unknown: always :info
+            return {**op, "type": "info",
+                    "error": ["mongo-write-concern", e.code, str(e)]}
         except MongoError as e:
             definite = op["f"] == "read" or e.code in DEFINITE_FAIL
             return {**op, "type": "fail" if definite else "info",

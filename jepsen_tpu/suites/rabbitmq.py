@@ -21,6 +21,7 @@ import base64
 import itertools
 import json
 import logging
+import threading
 import urllib.error
 import urllib.request
 
@@ -165,8 +166,11 @@ class QueueClient(MgmtClient):
             raise ValueError(f"unknown f {op['f']!r}")
         except (urllib.error.URLError, OSError, ValueError,
                 KeyError) as e:
-            t = "info" if op["f"] == "enqueue" else "fail"
-            return {**op, "type": t, "error": str(e)}
+            # Dequeue/drain use ack_requeue_false: the broker removes
+            # the message before we see the HTTP response, so a
+            # transport error is indeterminate — the message may be
+            # gone. Only :info keeps the total-queue checker sound.
+            return {**op, "type": "info", "error": str(e)}
 
 
 class MutexClient(MgmtClient):
@@ -178,15 +182,20 @@ class MutexClient(MgmtClient):
 
     QUEUE = "jepsen.semaphore"
 
+    # guards the seeded flag in the shared test map: without it two
+    # workers can both observe the empty list and mint two tokens
+    _seed_lock = threading.Lock()
+
     def __init__(self, timeout_s: float = 5.0):
         super().__init__(timeout_s)
         self.held = False
 
     def setup(self, test):
         super().setup(test)
-        if not test.setdefault("_mutex-seeded", []):
-            test["_mutex-seeded"].append(True)
-            self.publish("token")
+        with MutexClient._seed_lock:
+            if not test.setdefault("_mutex-seeded", []):
+                test["_mutex-seeded"].append(True)
+                self.publish("token")
 
     def invoke(self, test, op):
         try:

@@ -39,7 +39,9 @@ PROXY = ("stolon-proxy", f"{DIR}/proxy.log", f"{DIR}/proxy.pid")
 
 DEFAULT_VERSION = "0.16.0"
 
-DEFINITE_ABORT = {"40001", "40P01", "40003"}
+# 40003 (completion unknown) deliberately absent: ambiguous commits
+# must stay :info, not :fail (the txn may have applied).
+DEFINITE_ABORT = {"40001", "40P01"}
 
 
 def tarball_url(version: str) -> str:

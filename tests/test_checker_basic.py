@@ -226,6 +226,43 @@ def test_total_queue_lost_and_unexpected():
     assert r["lost-count"] == 1 and r["unexpected-count"] == 1
 
 
+def test_total_queue_indeterminate_dequeue_absorbs_loss():
+    """A :info dequeue may have destructively consumed the missing
+    message (destructive get, lost response): the verdict degrades to
+    unknown, not a false 'lost'."""
+    hist = History([
+        op("invoke", "enqueue", 1, process=0),
+        op("ok", "enqueue", 1, process=0),
+        op("invoke", "dequeue", None, process=1),
+        op("info", "dequeue", None, process=1),
+    ])
+    r = c.total_queue().check({}, hist, {})
+    assert r["valid?"] == "unknown"
+    assert r["lost-count"] == 1
+
+    # two losses, one indeterminate dequeue: still definitely lost one
+    hist2 = History([
+        op("invoke", "enqueue", 1, process=0),
+        op("ok", "enqueue", 1, process=0),
+        op("invoke", "enqueue", 2, process=0),
+        op("ok", "enqueue", 2, process=0),
+        op("invoke", "dequeue", None, process=1),
+        op("info", "dequeue", None, process=1),
+    ])
+    assert c.total_queue().check({}, hist2, {})["valid?"] is False
+
+    # a crashed drain absorbs any number of losses
+    hist3 = History([
+        op("invoke", "enqueue", 1, process=0),
+        op("ok", "enqueue", 1, process=0),
+        op("invoke", "enqueue", 2, process=0),
+        op("ok", "enqueue", 2, process=0),
+        op("invoke", "drain", None, process=1),
+        op("info", "drain", None, process=1),
+    ])
+    assert c.total_queue().check({}, hist3, {})["valid?"] == "unknown"
+
+
 # -- unique ids -------------------------------------------------------------------
 
 def test_unique_ids():
