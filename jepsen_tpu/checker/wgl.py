@@ -104,13 +104,44 @@ def mutex_codec(o: dict) -> tuple[int, int, int]:
     raise ValueError(f"unknown mutex op f={f!r}")
 
 
-# name -> (step fn, value codec, f-codes droppable when pending)
-DEVICE_MODELS: dict[str, tuple[Callable, Callable, frozenset]] = {
-    "cas-register": (_register_step(True), default_register_codec,
-                     frozenset({F_READ})),
-    "register": (_register_step(False), default_register_codec,
-                 frozenset({F_READ})),
-    "mutex": (_mutex_step, mutex_codec, frozenset()),
+@dataclasses.dataclass(frozen=True)
+class DeviceModel:
+    """A model with enumerable int32 state, steppable on device.
+
+    step        (state, f, a, b) -> (legal, new_state), broadcasting
+    codec       op dict -> (f, a, b) int encoding
+    droppable   f-codes whose pending (crashed) ops constrain nothing
+    state_range (init_state, f, a, b arrays) -> inclusive (lo, hi)
+                bounds on every reachable state — lets the kernel pack
+                a whole config into one u32 sort key when it fits
+    """
+    step: Callable
+    codec: Callable
+    droppable: frozenset
+    state_range: Callable
+
+    def __iter__(self):  # legacy tuple shape: (step, codec, droppable)
+        return iter((self.step, self.codec, self.droppable))
+
+
+def _register_range(init, f, a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    hi, lo = init, min(NIL, init)
+    for v in (a[a != NIL], b[b != NIL]):
+        if v.size:
+            hi = max(hi, int(v.max()))
+            lo = min(lo, int(v.min()))
+    return lo, hi
+
+
+DEVICE_MODELS: dict[str, DeviceModel] = {
+    "cas-register": DeviceModel(_register_step(True),
+                                default_register_codec,
+                                frozenset({F_READ}), _register_range),
+    "register": DeviceModel(_register_step(False), default_register_codec,
+                            frozenset({F_READ}), _register_range),
+    "mutex": DeviceModel(_mutex_step, mutex_codec, frozenset(),
+                         lambda init, f, a, b: (0, 1)),
 }
 
 
@@ -230,23 +261,50 @@ def _bucket(n: int, lo: int = 64) -> int:
 # ---------------------------------------------------------------------------
 
 Kernel = collections.namedtuple(
-    "Kernel", ["check", "check_batch", "check_chunk", "init_carry",
-               "summarize"])
+    "Kernel", ["check", "check_batch", "check_chunk", "check_chunk_batch",
+               "init_carry", "summarize"])
+
+
+def _pack_params(state_range: tuple[int, int] | None,
+                 P: int) -> tuple[int, int] | None:
+    """Normalize a state range to the (s_lo, sb_bits) the kernel is
+    actually specialized on — or None when packing is impossible — so
+    histories differing only in irrelevant value ranges share one
+    compiled kernel."""
+    if state_range is None or P > 32:
+        return None
+    s_lo = state_range[0]
+    sb_bits = (state_range[1] - state_range[0] + 1).bit_length()
+    if P + sb_bits + 1 > 32:
+        return None
+    return s_lo, sb_bits
 
 
 @functools.lru_cache(maxsize=32)
-def _kernel(model_name: str, F: int, P: int, E: int):
+def _kernel(model_name: str, F: int, P: int, E: int,
+            pack: tuple[int, int] | None = None):
     """Build the jitted checker for a (model, frontier-size, slots,
     entry-capacity) shape. Returns fn(entry arrays..., n_entries) ->
-    (ok, death_entry, overflow, max_frontier)."""
+    (ok, death_entry, overflow, max_frontier).
+
+    pack: (s_lo, sb_bits) from _pack_params. When the whole config
+    (invalid flag, biased state, P-bit pending mask) fits one uint32,
+    dedup packs it into a single sort key; the multi-word
+    lexicographic sort is the kernel's dominant cost, so this is the
+    difference between sorting one u32 lane and W+2 lanes per entry."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    step = DEVICE_MODELS[model_name][0]
+    step = DEVICE_MODELS[model_name].step
     W = max(1, (P + 31) // 32)
     u32 = jnp.uint32
     i32 = jnp.int32
+    if pack is not None:
+        s_lo, sb_bits = pack
+    else:
+        s_lo, sb_bits = 0, 64
+    packed = pack is not None and W == 1
 
     def bit_vec(slot):
         word = slot // 32
@@ -257,6 +315,25 @@ def _kernel(model_name: str, F: int, P: int, E: int):
     def has_bit(masks, bv):
         return (masks & bv[None, :]).astype(jnp.bool_).any(axis=1)
 
+    def _neq_prev(x):
+        return jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), x[1:] != x[:-1]])
+
+    def dedup_packed(masks, states, valid, origin):
+        """Single-key dedup: key = invalid<<31 | (state-lo)<<P | mask."""
+        key = jnp.where(valid, u32(0), u32(1) << 31) \
+            | ((states - s_lo).astype(u32) << P) | masks[:, 0]
+        key_s, org_s = lax.sort([key, origin.astype(i32)], num_keys=1,
+                                is_stable=True)
+        valid_s = (key_s >> 31 == 0) & _neq_prev(key_s)
+        overflow = valid_s[F:].any() if len(key) > F else jnp.bool_(False)
+        masks_f = (key_s[:F] & u32((1 << P) - 1))[:, None]
+        states_f = ((key_s[:F] >> P) & u32((1 << sb_bits) - 1)) \
+            .astype(i32) + s_lo
+        valid_f = valid_s[:F]
+        new_f = valid_f & (org_s[:F] == 1)
+        return masks_f, states_f, valid_f, new_f, valid_f.sum(), overflow
+
     def dedup(masks, states, valid, origin):
         """Sort (N,)-rows lexicographically by (invalid, mask words, state);
         mark duplicate keys invalid (stable sort + old-configs-first makes
@@ -264,18 +341,17 @@ def _kernel(model_name: str, F: int, P: int, E: int):
 
         Returns (masks[F,W], states[F], valid[F], new[F], count, overflow).
         """
+        if packed:
+            return dedup_packed(masks, states, valid, origin)
         invalid_key = (~valid).astype(u32)
         operands = [invalid_key] + [masks[:, w] for w in range(W)] \
             + [states, origin.astype(i32)]
         out = lax.sort(operands, num_keys=W + 2, is_stable=True)
         inv_s, ms, st_s, org_s = out[0], out[1:1 + W], out[1 + W], out[2 + W]
 
-        def neq_prev(x):
-            return jnp.concatenate(
-                [jnp.ones((1,), jnp.bool_), x[1:] != x[:-1]])
-        first = neq_prev(inv_s) | neq_prev(st_s)
+        first = _neq_prev(inv_s) | _neq_prev(st_s)
         for mw in ms:
-            first = first | neq_prev(mw)
+            first = first | _neq_prev(mw)
         valid_s = (inv_s == 0) & first
         overflow = valid_s[F:].any() if len(inv_s) > F else jnp.bool_(False)
         masks_f = jnp.stack([mw[:F] for mw in ms], axis=1)
@@ -385,15 +461,17 @@ def _kernel(model_name: str, F: int, P: int, E: int):
 
         def return_entry(e, masks, states, valid, slot_f, slot_a, slot_b,
                          slot_occ, overflow):
+            # No dedup needed: every survivor has bit s set, and
+            # clearing a set bit is injective on masks, so distinct
+            # surviving configs stay distinct. Skipping the sort here
+            # removes a third of the kernel's sorts.
             s = es[e]
             bv = bit_vec(s)
             valid = valid & has_bit(masks, bv)
             masks = masks & ~bv[None, :]
             slot_occ = slot_occ.at[s].set(False)
-            masks, states, valid, _, _, ovf = dedup(
-                masks, states, valid, jnp.zeros(F, jnp.bool_))
             return masks, states, valid, slot_f, slot_a, slot_b, slot_occ, \
-                overflow | ovf
+                overflow
 
         def noop_entry(e, *c):
             return c
@@ -437,7 +515,193 @@ def _kernel(model_name: str, F: int, P: int, E: int):
     def check_chunk(ek, es, ef, ea, eb, stop, carry):
         return run_range(ek, es, ef, ea, eb, stop, carry)
 
-    return Kernel(check, check_batch, check_chunk, init_carry, summarize)
+    @jax.jit
+    def check_chunk_batch(ek, es, ef, ea, eb, stops, carry):
+        return jax.vmap(run_range, in_axes=(0, 0, 0, 0, 0, 0, 0))(
+            ek, es, ef, ea, eb, stops, carry)
+
+    return Kernel(check, check_batch, check_chunk, check_chunk_batch,
+                  init_carry, summarize)
+
+
+# ---------------------------------------------------------------------------
+# Dense reachable-set kernel (symbolic model checking on device)
+# ---------------------------------------------------------------------------
+#
+# When the model's state count S and the slot count P are small enough
+# that S * 2^P fits in device memory, the *entire* configuration space
+# fits a dense boolean table T[state, pending-mask]. Every history entry
+# is then a vectorized transform of the whole table:
+#
+#   * linearizing pending op p from (s, m) reaches (step(s), m | bit_p):
+#     a tiny SxS boolean "transition matmul" over the state axis composed
+#     with a bit-set gather along the mask axis — for ALL P pending slots
+#     at once, as one batched (P, S, C) op, iterated to fixpoint;
+#   * an :ok return keeps configs holding the op's bit and clears it —
+#     a pure gather;
+#   * the history is linearizable iff the table is ever nonempty after
+#     the last entry.
+#
+# No sort, no frontier cap, no overflow, no escalation: verdicts are
+# EXACT. The sort-frontier kernel above remains the fallback for
+# histories whose peak pending-op count P makes 2^P infeasible. This is
+# the idiomatic TPU shape for WGL search: the pending-subset powerset
+# that explodes knossos (`checker.clj:213-216`) becomes the lane axis.
+
+DENSE_TABLE_CAP = 1 << 22   # max S * 2^P bools held as the dense table
+
+
+@functools.lru_cache(maxsize=32)
+def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
+    """Build the jitted dense-table checker for S states x P slots x
+    E entry capacity. Same call shapes as the sort kernel."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    step = DEVICE_MODELS[model_name].step
+    C = 1 << P
+    i32 = jnp.int32
+    f32 = jnp.float32
+    s_vals = s_lo + np.arange(S, dtype=np.int32)           # (S,)
+    cols = np.arange(C, dtype=np.int32)                    # (C,)
+    idx_xor = cols[None, :] ^ (1 << np.arange(P))[:, None]  # (P, C) c^bit
+    has_bit = ((cols[None, :] >> np.arange(P)[:, None]) & 1).astype(bool)
+
+    S_VALS = jnp.asarray(s_vals)
+    IDX_XOR = jnp.asarray(idx_xor)
+    HAS_BIT = jnp.asarray(has_bit)
+
+    def closure(table, slot_f, slot_a, slot_b, slot_occ):
+        """Close the table under linearization of every occupied slot."""
+        legal, new = step(S_VALS[None, :], slot_f[:, None],
+                          slot_a[:, None], slot_b[:, None])     # (P, S)
+        legal = legal & slot_occ[:, None]
+        # M[p, s, s2]: linearizing slot p moves state s to s2
+        M = (legal[:, :, None]
+             & (new[:, :, None] == S_VALS[None, None, :]))      # (P,S,S2)
+        Mf = M.astype(f32)
+
+        # fixpoint: iterate while the popcount grows
+        def wcond(c):
+            tb, cnt, prev = c
+            return cnt != prev
+
+        def wbody(c):
+            tb, cnt, _ = c
+            moved = jnp.einsum("psq,sc->pqc", Mf,
+                               tb.astype(f32)) > 0               # (P,S2,C)
+            # destination (s2, c-with-bit) comes from source col c^bit
+            shifted = jnp.take_along_axis(
+                moved, IDX_XOR[:, None, :], axis=2)              # (P,S2,C)
+            cand = shifted & HAS_BIT[:, None, :]
+            tb = tb | cand.any(axis=0)
+            return tb, tb.sum().astype(i32), cnt
+
+        table, _, _ = lax.while_loop(
+            wcond, wbody,
+            (table, table.sum().astype(i32), i32(-1)))
+        return table
+
+    def init_carry(init_state):
+        table = jnp.zeros((S, C), jnp.bool_)
+        table = table.at[init_state - s_lo, 0].set(True)
+        return (i32(0), table,
+                jnp.zeros((P,), i32), jnp.full((P,), NIL, i32),
+                jnp.full((P,), NIL, i32), jnp.zeros((P,), jnp.bool_),
+                i32(1), i32(1))
+
+    def summarize(carry):
+        e, table, *_slots, count, max_count = carry
+        ok = count > 0
+        death = jnp.where(ok, i32(-1), e - 1)
+        # the dense table never drops configurations: overflow is
+        # impossible and every verdict is exact
+        return ok, death, jnp.bool_(False), max_count
+
+    def run_range(ek, es, ef, ea, eb, stop, carry):
+        def invoke_entry(e, table, slot_f, slot_a, slot_b, slot_occ):
+            s, f, a, b = es[e], ef[e], ea[e], eb[e]
+            slot_f = slot_f.at[s].set(f)
+            slot_a = slot_a.at[s].set(a)
+            slot_b = slot_b.at[s].set(b)
+            slot_occ = slot_occ.at[s].set(True)
+            table = closure(table, slot_f, slot_a, slot_b, slot_occ)
+            return table, slot_f, slot_a, slot_b, slot_occ
+
+        def return_entry(e, table, slot_f, slot_a, slot_b, slot_occ):
+            # survivors hold the bit; the new config is the same mask
+            # with the bit cleared (an injective move: no dedup needed,
+            # and closure is preserved, so no re-expansion either)
+            s = es[e]
+            kept = jnp.take_along_axis(table, IDX_XOR[s][None, :], axis=1)
+            table = jnp.where(HAS_BIT[s][None, :], False, kept)
+            slot_occ = slot_occ.at[s].set(False)
+            return table, slot_f, slot_a, slot_b, slot_occ
+
+        def noop_entry(e, *c):
+            return c
+
+        def cond(c):
+            return (c[0] < stop) & (c[6] > 0)
+
+        def body(c):
+            e, table, slot_f, slot_a, slot_b, slot_occ, count, maxc = c
+            table, slot_f, slot_a, slot_b, slot_occ = lax.switch(
+                ek[e],
+                [lambda args: invoke_entry(e, *args),
+                 lambda args: return_entry(e, *args),
+                 lambda args: noop_entry(e, *args)],
+                (table, slot_f, slot_a, slot_b, slot_occ))
+            count = table.sum().astype(i32)
+            return (e + 1, table, slot_f, slot_a, slot_b, slot_occ,
+                    count, jnp.maximum(maxc, count))
+
+        return lax.while_loop(cond, body, carry)
+
+    def make_check(ek, es, ef, ea, eb, n_entries, init_state):
+        return summarize(run_range(ek, es, ef, ea, eb, n_entries,
+                                   init_carry(init_state)))
+
+    @jax.jit
+    def check(ek, es, ef, ea, eb, n_entries, init_state):
+        return make_check(ek, es, ef, ea, eb, n_entries, init_state)
+
+    @jax.jit
+    def check_batch(ek, es, ef, ea, eb, n_entries, init_state):
+        return jax.vmap(make_check)(ek, es, ef, ea, eb, n_entries,
+                                    init_state)
+
+    @jax.jit
+    def check_chunk(ek, es, ef, ea, eb, stop, carry):
+        return run_range(ek, es, ef, ea, eb, stop, carry)
+
+    @jax.jit
+    def check_chunk_batch(ek, es, ef, ea, eb, stops, carry):
+        return jax.vmap(run_range, in_axes=(0, 0, 0, 0, 0, 0, 0))(
+            ek, es, ef, ea, eb, stops, carry)
+
+    return Kernel(check, check_batch, check_chunk, check_chunk_batch,
+                  init_carry, summarize)
+
+
+DENSE_STATE_CAP = 512  # closure() is O(P * S^2 * C): bound S too
+
+
+def _dense_shape(srange: tuple[int, int],
+                 p_exact: int) -> tuple[int, int, int] | None:
+    """(s_lo, S_bucketed, P_exact) if the dense table fits the caps,
+    else None. S is bucketed to a power of two so histories differing
+    only in value range share a compiled kernel — the padding rows are
+    unreachable states and never become true."""
+    lo, hi = srange
+    S = hi - lo + 1
+    if S > DENSE_STATE_CAP:
+        return None
+    S = _bucket(S, lo=4)
+    if S * (1 << p_exact) <= DENSE_TABLE_CAP:
+        return lo, S, p_exact
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -460,7 +724,8 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                  budget_s: float | None = None,
                  cancel=None,
                  explain: bool = True,
-                 slot_overflow_fallback: bool = True) -> dict:
+                 slot_overflow_fallback: bool = True,
+                 engine: str = "auto") -> dict:
     """Check one history on the device. The slot count is sized to the
     history's actual peak concurrency; long histories run as a sequence
     of bounded-duration chunked kernel calls with the frontier carried
@@ -478,19 +743,25 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
     search with 'unknown' (competition racing). explain: on a definite
     invalid verdict, re-run the host oracle on the prefix ending at the
     culprit op to reconstruct configs and final-paths (the reference
-    renders these via knossos.linear.report, `checker.clj:205-216`)."""
+    renders these via knossos.linear.report, `checker.clj:205-216`).
+
+    engine: 'auto' uses the dense reachable-set kernel whenever the
+    model's S x 2^P configuration space fits DENSE_TABLE_CAP (exact
+    verdicts, no frontier), else the sort-frontier kernel; 'dense' /
+    'sort' force one."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
     name = model.device_model
     ops = encode_ops_for_model(model, hist)
+    p_exact = required_slots(ops)
     if slots is None:
-        slots = _bucket(required_slots(ops), lo=8)
+        slots = _bucket(p_exact, lo=8)
     try:
         entries = build_entries(ops, slots)
     except SlotOverflow:
         # caller-supplied slots too small: size from the history
-        slots = _bucket(required_slots(ops), lo=8)
+        slots = _bucket(p_exact, lo=8)
         if slots <= 256:
             entries = build_entries(ops, slots)
     if slots > 256:
@@ -504,6 +775,17 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
         a["analyzer"] = "host-jit-linear (slot overflow)"
         return a
     E = _bucket(max(entries.n, 1))
+    srange = _state_range(name, model, [entries])
+    dense = None
+    if engine in ("auto", "dense"):
+        dense = _dense_shape(srange, p_exact)
+        if dense is not None:
+            # exact-P entry stream: the dense table is 2^P wide
+            entries = build_entries(ops, dense[2])
+        elif engine == "dense":
+            raise ValueError(
+                f"dense engine requested but the {srange} state range x "
+                f"2^{p_exact} table exceeds the dense caps")
     entries = entries.pad_to(E)
     args = (jnp.asarray(entries.kind), jnp.asarray(entries.slot),
             jnp.asarray(entries.f), jnp.asarray(entries.a),
@@ -511,7 +793,10 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
     F = frontier
     timed_out = cancelled = False
     while True:
-        k = _kernel(name, F, slots, E)
+        if dense is not None:
+            k = _dense_kernel(name, dense[0], dense[1], dense[2], E)
+        else:
+            k = _kernel(name, F, slots, E, _pack_params(srange, slots))
         carry = k.init_carry(jnp.int32(model.device_state()))
         e = 0
         while e < entries.n:
@@ -539,7 +824,7 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
     out = {
         "valid?": (True if ok else
                    "unknown" if overflow else False),
-        "analyzer": "tpu-wgl",
+        "analyzer": "tpu-wgl-dense" if dense is not None else "tpu-wgl",
         "op-count": len(ops),
         "max-frontier": int(max_count),
         "frontier-size": F,
@@ -590,13 +875,36 @@ def _find_op(hist, index: int):
     return None
 
 
+def _state_range(name: str, model, entries_list) -> tuple[int, int]:
+    """Combined inclusive state bounds over a batch of entry streams."""
+    lo = hi = int(model.device_state())
+    rng = DEVICE_MODELS[name].state_range
+    for e in entries_list:
+        l2, h2 = rng(int(model.device_state()), e.f, e.a, e.b)
+        lo, hi = min(lo, l2), max(hi, h2)
+    return int(lo), int(hi)
+
+
 def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
-                       slots: int = 32) -> list[dict]:
+                       slots: int = 32, chunk_entries: int = 4096,
+                       budget_s: float | None = None,
+                       cancel=None) -> list[dict]:
     """Check a batch of independent histories (e.g. per-key subhistories
-    from the independent workload) in one vmapped device call."""
+    from the independent workload) in vmapped device calls. Long batches
+    run as bounded-duration chunks with the vmapped frontier carried
+    between calls, polling budget_s / cancel like the scalar path —
+    a pathological key can no longer stall an independent batch
+    unboundedly. Undecided keys at the budget report 'unknown'."""
+    import jax
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
+
+    def _remaining():
+        if budget_s is None:
+            return None
+        return max(0.0, budget_s - (_time.monotonic() - t0))
+
     name = model.device_model
     all_entries = []
     host_fallback: dict[int, dict] = {}
@@ -605,7 +913,8 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         try:
             all_entries.append((i, ops, build_entries(ops, slots)))
         except SlotOverflow:
-            a = analysis_tpu(model, h, frontier, slots * 2)
+            a = analysis_tpu(model, h, frontier, slots * 2,
+                             budget_s=_remaining(), cancel=cancel)
             host_fallback[i] = a
     results: list[dict | None] = [None] * len(hists)
     for i, a in host_fallback.items():
@@ -613,25 +922,56 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     if all_entries:
         E = _bucket(max(e.n for _, _, e in all_entries))
         padded = [e.pad_to(E) for _, _, e in all_entries]
-        check_batch = _kernel(name, frontier, slots, E).check_batch
-        ok, death, overflow, max_count = check_batch(
-            _stack([e.kind for e in padded]),
-            _stack([e.slot for e in padded]),
-            _stack([e.f for e in padded]), _stack([e.a for e in padded]),
-            _stack([e.b for e in padded]),
-            jnp.asarray(np.asarray([e.n for e in padded], np.int32)),
-            jnp.asarray(np.full(len(padded), model.device_state(),
-                                np.int32)))
+        srange = _state_range(name, model, padded)
+        k = _kernel(name, frontier, slots, E,
+                    _pack_params(srange, slots))
+        args = (_stack([e.kind for e in padded]),
+                _stack([e.slot for e in padded]),
+                _stack([e.f for e in padded]),
+                _stack([e.a for e in padded]),
+                _stack([e.b for e in padded]))
+        ns = np.asarray([e.n for e in padded], np.int32)
+        carry = jax.vmap(k.init_carry)(
+            jnp.full(len(padded), model.device_state(), jnp.int32))
+        e = 0
+        n_max = int(ns.max())
+        while e < n_max:
+            stop = min(e + chunk_entries, n_max)
+            carry = k.check_chunk_batch(
+                *args, jnp.asarray(np.minimum(ns, stop)), carry)
+            e = stop
+            counts = np.asarray(carry[9])
+            if not counts.any():
+                break   # every frontier died: all verdicts definite
+            if e < n_max:
+                if (budget_s is not None
+                        and _time.monotonic() - t0 > budget_s) \
+                        or (cancel is not None and cancel()):
+                    break
+        ok, death, overflow, max_count = jax.vmap(k.summarize)(carry)
         ok = np.asarray(ok)
         death = np.asarray(death)
         overflow = np.asarray(overflow)
+        counts = np.asarray(carry[9])
+        # a key is decided if it consumed all entries or its frontier
+        # died (death is definitive no matter how many entries remain)
+        decided = (np.asarray(carry[0]) >= ns) | (counts == 0)
         for j, (i, ops, entries) in enumerate(all_entries):
+            if not bool(decided[j]):
+                results[i] = {
+                    "valid?": "unknown", "analyzer": "tpu-wgl-batch",
+                    "op-count": len(ops),
+                    "error": ("batch budget exhausted/cancelled before "
+                              "this key's search finished"),
+                    "configs": [], "final-paths": []}
+                continue
             if bool(ok[j]):
                 v: Any = True
             elif bool(overflow[j]):
-                # escalate this key alone
+                # escalate this key alone, within the remaining budget
                 results[i] = analysis_tpu(model, hists[i], frontier * 4,
-                                          slots)
+                                          slots, budget_s=_remaining(),
+                                          cancel=cancel)
                 continue
             else:
                 v = False
@@ -687,7 +1027,9 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
 
     from functools import partial
 
-    check_batch = _kernel(name, frontier, slots, E).check_batch
+    srange = _state_range(name, model, padded)
+    check_batch = _kernel(name, frontier, slots, E,
+                          _pack_params(srange, slots)).check_batch
 
     # check_vma=False: the kernel's inner lax loops create fresh constants
     # whose varying-manual-axes tags can't match the sharded carries; the

@@ -320,3 +320,89 @@ def test_cancel_hook_stops_device_search():
                          chunk_entries=16, cancel=lambda: True)
     assert a["valid?"] == "unknown"
     assert "cancelled" in a["error"]
+
+
+def test_batch_budget_returns_unknown_for_undecided_keys():
+    """A zero budget with tiny chunks leaves later keys undecided:
+    they must report 'unknown', not stall or claim a verdict."""
+    hs = [synth.register_history(400, concurrency=4, values=4,
+                                 crash_rate=0.01, seed=s)
+          for s in range(4)]
+    rs = analysis_tpu_batch(models.cas_register(), hs, frontier=64,
+                            slots=16, chunk_entries=8, budget_s=0.0)
+    assert len(rs) == 4
+    assert all(r["valid?"] in (True, False, "unknown") for r in rs)
+    assert any(r["valid?"] == "unknown" for r in rs)
+
+
+def test_batch_budget_none_still_decides_everything():
+    hs = [synth.register_history(200, concurrency=4, values=4,
+                                 crash_rate=0.01, seed=s)
+          for s in range(3)]
+    hs.append(synth.corrupt(hs[0]))
+    rs = analysis_tpu_batch(models.cas_register(), hs, frontier=128,
+                            slots=16, chunk_entries=64)
+    assert [r["valid?"] for r in rs] == [True, True, True, False]
+
+
+def test_adversarial_history_device_vs_host():
+    """The adversarial crashed-write shape must verify on BOTH device
+    engines and agree with the host oracle at small scale."""
+    h = synth.adversarial_register_history(300, concurrency=4,
+                                           crashed_writes=4)
+    a = analysis_tpu(models.cas_register(), h, frontier=2048)
+    assert a["valid?"] is True and a["analyzer"] == "tpu-wgl-dense"
+    s = analysis_tpu(models.cas_register(), h, frontier=2048,
+                     engine="sort")
+    assert s["valid?"] is True and s["analyzer"] == "tpu-wgl"
+    assert analysis_host(models.cas_register(), h)["valid?"] is True
+    bad = synth.corrupt(h)
+    a2 = analysis_tpu(models.cas_register(), bad, frontier=2048)
+    assert a2["valid?"] is False
+
+
+def test_packed_and_unpacked_dedup_agree():
+    """P=16 with small values packs the config into one u32 sort key;
+    P=64 forces the multi-word path. Same verdicts either way (pinned
+    to the sort engine — auto would route these to the dense kernel)."""
+    for seed in (1, 2):
+        h = synth.register_history(300, concurrency=5, values=4,
+                                   crash_rate=0.02, seed=seed)
+        packed = analysis_tpu(models.cas_register(), h, frontier=256,
+                              slots=16, engine="sort")
+        wide = analysis_tpu(models.cas_register(), h, frontier=256,
+                            slots=64, engine="sort")
+        assert packed["valid?"] is wide["valid?"] is True
+        bad = synth.corrupt(h)
+        pb = analysis_tpu(models.cas_register(), bad, frontier=256,
+                          slots=16, engine="sort")
+        wb = analysis_tpu(models.cas_register(), bad, frontier=256,
+                          slots=64, engine="sort")
+        assert pb["valid?"] is wb["valid?"] is False
+        assert pb["op-index"] == wb["op-index"]
+
+
+def test_dense_and_sort_engines_agree_on_random_histories():
+    for seed in (11, 12, 13):
+        h = synth.register_history(250, concurrency=5, values=4,
+                                   crash_rate=0.05, seed=seed)
+        d = analysis_tpu(models.cas_register(), h)
+        s = analysis_tpu(models.cas_register(), h, frontier=1024,
+                         engine="sort")
+        assert d["analyzer"] == "tpu-wgl-dense"
+        assert d["valid?"] is s["valid?"]
+
+
+def test_negative_register_values():
+    """States below -1 must extend the packed/dense state range
+    downward, not wrap the u32 key or fall off the dense table."""
+    h = [op("invoke", "write", -3, 0), op("ok", "write", -3, 0),
+         op("invoke", "read", None, 0), op("ok", "read", -3, 0)]
+    for engine in ("dense", "sort"):
+        a = analysis_tpu(models.cas_register(), History(h), engine=engine)
+        assert a["valid?"] is True, (engine, a)
+    bad = [op("invoke", "write", -3, 0), op("ok", "write", -3, 0),
+           op("invoke", "read", None, 0), op("ok", "read", -2, 0)]
+    for engine in ("dense", "sort"):
+        a = analysis_tpu(models.cas_register(), History(bad), engine=engine)
+        assert a["valid?"] is False, (engine, a)
