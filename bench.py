@@ -1,16 +1,23 @@
 #!/usr/bin/env python
 """Headline benchmark: history verification throughput on TPU.
 
-Two north-star configs (BASELINE.md):
-  * WGL linearizability on a 10k-op concurrent CAS-register history
-    (the reference's CPU Knossos needs a 32 GB heap, `jepsen/
-    project.clj:38`, and times out ~1 h on 10k ops — that timeout is the
-    vs_baseline denominator). We also report the *measured* host-oracle
-    result on the same history under a 60 s budget, so the baseline
-    framing is checked against a real run, not only the assumed timeout.
-  * Elle list-append cycle analysis on a 100k-txn history (config 5).
-    The north-star grading is "max history length solved < 300 s", so
-    vs_baseline is speedup over 100k txns / 300 s.
+Covers every BASELINE.md config plus the adversarial headline proof:
+
+  * headline metric (round-over-round comparable): WGL linearizability
+    throughput on the 10k-op concurrent CAS-register history.
+  * extra.adversarial_10k: a 10k-op history with front-loaded crashed
+    writes (the shape the reference calls out at `checker.clj:213-216`
+    — ":info ops hold slots forever", hours/32 GB on CPU knossos).
+    The host oracle is *measured* against a 60 s budget on this exact
+    history (it blows it; full-run measurements put it past 450 s);
+    the device answers exactly. The reported speedup is a lower bound
+    (budget / device time), not an assumed timeout.
+  * extra.configs: BASELINE configs 1-5 —
+      1 tutorial-scale 200-op register (CPU parity),
+      2 zookeeper-shape 2k-op WGL register,
+      3 cockroach-shape 10k-txn elle rw-register,
+      4 hazelcast-shape 50k ops sharded over the device mesh,
+      5 tidb-shape 100k-txn elle list-append (north star < 300 s).
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N,
@@ -21,75 +28,156 @@ import json
 import sys
 import time
 
+
+def _note(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
 N_OPS = 10_000
 CONCURRENCY = 5
 BASELINE_OPS_PER_SEC = N_OPS / 3600.0  # CPU knossos: 1 h timeout on 10k ops
 N_TXNS = 100_000
 BASELINE_TXNS_PER_SEC = N_TXNS / 300.0  # north star: solved < 300 s
+HOST_BUDGET_S = 60.0
+
+
+def _best_of(fn, n=3):
+    best = float("inf")
+    out = None
+    for _ in range(n):
+        t0 = time.monotonic()
+        out = fn()
+        best = min(best, time.monotonic() - t0)
+    return best, out
 
 
 def main() -> int:
     from jepsen_tpu import models
     from jepsen_tpu.checker import synth
-    from jepsen_tpu.checker.elle import list_append
+    from jepsen_tpu.checker.elle import list_append, wr
     from jepsen_tpu.checker.linear import analysis_host
-    from jepsen_tpu.checker.wgl import analysis_tpu
+    from jepsen_tpu.checker.wgl import analysis_tpu, check_batch_sharded
 
+    model = models.cas_register()
+    extra = {}
+
+    # ---- headline: easy 10k-op history (comparable to r01/r02) ----
+    _note("headline: easy 10k")
     hist = synth.register_history(N_OPS, concurrency=CONCURRENCY, values=5,
                                   crash_rate=0.0005, seed=45100)
-    model = models.cas_register()
-
-    # First call compiles (~20-40 s on TPU); benchmark the steady state.
-    a = analysis_tpu(model, hist, budget_s=420)
+    a = analysis_tpu(model, hist, budget_s=420)   # compile + first run
     assert a["valid?"] is True, f"benchmark history must verify: {a}"
-
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.monotonic()
-        a = analysis_tpu(model, hist)
-        best = min(best, time.monotonic() - t0)
+    best, a = _best_of(lambda: analysis_tpu(model, hist))
     assert a["valid?"] is True
     value = N_OPS / best
+    extra["wgl_best_s"] = round(best, 3)
+    extra["wgl_engine"] = a["analyzer"]
 
-    # measured host oracle on the same history, 60 s budget
+    # ---- adversarial 10k: measured host blowout vs exact device ----
+    _note("adversarial 10k")
+    adv = synth.adversarial_register_history(
+        N_OPS, concurrency=6, crashed_writes=7, front_load=True,
+        seed=45100)
+    analysis_tpu(model, adv, budget_s=420)   # warm: compile this shape
     t0 = time.monotonic()
-    host = analysis_host(model, hist, budget_s=60)
-    host_s = time.monotonic() - t0
+    ta = analysis_tpu(model, adv, budget_s=420)
+    adv_tpu_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    host = analysis_host(model, adv, budget_s=HOST_BUDGET_S)
+    adv_host_s = time.monotonic() - t0
     host_done = host["valid?"] is True
+    extra["adversarial_10k"] = {
+        "shape": "concurrency 6, 7 crashed writes front-loaded",
+        "tpu": {"seconds": round(adv_tpu_s, 2),
+                "verdict": str(ta["valid?"]),
+                "engine": ta["analyzer"],
+                "ops_per_s": round(N_OPS / adv_tpu_s, 1),
+                "configs_tracked": ta.get("max-frontier")},
+        "host": {"budget_s": HOST_BUDGET_S,
+                 "completed_in_budget": host_done,
+                 "seconds": round(adv_host_s, 1),
+                 "verdict": str(host["valid?"])},
+        "speedup_lower_bound": (round(HOST_BUDGET_S / adv_tpu_s, 1)
+                                if not host_done and ta["valid?"] is True
+                                else None),
+    }
 
-    # elle list-append at config-5 scale (100k txns), end-to-end
+    configs = {}
+
+    # ---- config 1: tutorial-scale 200-op register (parity) ----
+    _note("config 1")
+    h1 = synth.register_history(200, concurrency=5, values=5,
+                                crash_rate=0.01, seed=45100)
+    t1_host, r1h = _best_of(lambda: analysis_host(model, h1))
+    t1_tpu, r1t = _best_of(lambda: analysis_tpu(model, h1))
+    assert r1h["valid?"] is True and r1t["valid?"] is True
+    configs["1_register_200"] = {
+        "host_s": round(t1_host, 4), "tpu_s": round(t1_tpu, 4),
+        "target": "parity", "tpu_over_host": round(t1_host / t1_tpu, 2)}
+
+    # ---- config 2: zookeeper-shape 2k-op WGL register ----
+    _note("config 2")
+    h2 = synth.register_history(2000, concurrency=5, values=5,
+                                crash_rate=0.005, seed=45100)
+    t2_host, r2h = _best_of(lambda: analysis_host(model, h2), 1)
+    t2_tpu, r2t = _best_of(lambda: analysis_tpu(model, h2))
+    assert r2h["valid?"] is True and r2t["valid?"] is True
+    configs["2_register_wgl_2k"] = {
+        "host_s": round(t2_host, 3), "tpu_s": round(t2_tpu, 3),
+        "ops_per_s": round(2000 / t2_tpu, 1),
+        "speedup_vs_host": round(t2_host / t2_tpu, 2)}
+
+    # ---- config 3: cockroach-shape 10k-txn elle rw-register ----
+    _note("config 3")
+    h3 = synth.wr_history(10_000, seed=45100)
+    t0 = time.monotonic()
+    r3 = wr.check(h3)
+    t3 = time.monotonic() - t0
+    assert r3["valid?"] is True, f"wr bench history must verify: {r3}"
+    configs["3_elle_wr_10k"] = {
+        "seconds": round(t3, 2), "txns_per_s": round(10_000 / t3, 1)}
+
+    # ---- config 4: 50k ops sharded over the device mesh ----
+    _note("config 4")
+    keys = 100
+    per_key = [synth.register_history(500, concurrency=4, values=5,
+                                      crash_rate=0.005, seed=1000 + i)
+               for i in range(keys)]
+    check_batch_sharded(model, per_key, slots=16)   # compile
+    t0 = time.monotonic()
+    all_ok, per_ok = check_batch_sharded(model, per_key, slots=16)
+    t4 = time.monotonic() - t0
+    assert all_ok and per_ok.all()
+    configs["4_sharded_50k"] = {
+        "keys": keys, "seconds": round(t4, 2),
+        "ops_per_s": round(keys * 500 / t4, 1)}
+
+    # ---- config 5: 100k-txn elle list-append ----
+    _note("config 5")
     eh = synth.append_history(N_TXNS, seed=45100)
     t0 = time.monotonic()
     er = list_append.check(eh)
     elle_s = time.monotonic() - t0
     assert er["valid?"] is True, f"elle bench history must verify: {er}"
     elle_rate = N_TXNS / elle_s
-    # and an anomalous variant must still classify (exercises the MXU path)
     bad = synth.inject_append_cycles(eh, 64, "G1c")
     t0 = time.monotonic()
     br = list_append.check(bad)
     elle_bad_s = time.monotonic() - t0
     assert br["valid?"] is False and "G1c" in br["anomaly-types"]
+    configs["5_elle_append_100k"] = {
+        "seconds": round(elle_s, 2), "txns_per_s": round(elle_rate, 1),
+        "vs_baseline": round(elle_rate / BASELINE_TXNS_PER_SEC, 1),
+        "with_64_injected_cycles_s": round(elle_bad_s, 2)}
+
+    extra["configs"] = configs
 
     print(json.dumps({
         "metric": ("linearizability verification throughput, 10k-op "
-                   "concurrent CAS-register history (WGL frontier search)"),
+                   "concurrent CAS-register history (WGL search)"),
         "value": round(value, 1),
         "unit": "ops/s",
         "vs_baseline": round(value / BASELINE_OPS_PER_SEC, 1),
-        "extra": {
-            "wgl_best_s": round(best, 3),
-            "host_oracle_10k": {
-                "completed_in_60s": host_done,
-                "seconds": round(host_s, 1),
-                "verdict": str(host["valid?"])},
-            "elle_append_100k": {
-                "value": round(elle_rate, 1),
-                "unit": "txns/s",
-                "seconds": round(elle_s, 2),
-                "vs_baseline": round(elle_rate / BASELINE_TXNS_PER_SEC, 1)},
-            "elle_append_100k_with_64_cycles_s": round(elle_bad_s, 2),
-        },
+        "extra": extra,
     }))
     return 0
 

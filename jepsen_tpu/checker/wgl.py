@@ -888,7 +888,7 @@ def _state_range(name: str, model, entries_list) -> tuple[int, int]:
 def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                        slots: int = 32, chunk_entries: int = 4096,
                        budget_s: float | None = None,
-                       cancel=None) -> list[dict]:
+                       cancel=None, engine: str = "auto") -> list[dict]:
     """Check a batch of independent histories (e.g. per-key subhistories
     from the independent workload) in vmapped device calls. Long batches
     run as bounded-duration chunks with the vmapped frontier carried
@@ -923,8 +923,16 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         E = _bucket(max(e.n for _, _, e in all_entries))
         padded = [e.pad_to(E) for _, _, e in all_entries]
         srange = _state_range(name, model, padded)
-        k = _kernel(name, frontier, slots, E,
-                    _pack_params(srange, slots))
+        dense = _dense_shape(srange, max(
+            required_slots(ops) for _, ops, _ in all_entries)) \
+            if engine in ("auto", "dense") else None
+        if dense is not None:
+            padded = [build_entries(ops, dense[2]).pad_to(E)
+                      for _, ops, _ in all_entries]
+            k = _dense_kernel(name, dense[0], dense[1], dense[2], E)
+        else:
+            k = _kernel(name, frontier, slots, E,
+                        _pack_params(srange, slots))
         args = (_stack([e.kind for e in padded]),
                 _stack([e.slot for e in padded]),
                 _stack([e.f for e in padded]),
@@ -940,7 +948,7 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
             carry = k.check_chunk_batch(
                 *args, jnp.asarray(np.minimum(ns, stop)), carry)
             e = stop
-            counts = np.asarray(carry[9])
+            counts = np.asarray(carry[-2])
             if not counts.any():
                 break   # every frontier died: all verdicts definite
             if e < n_max:
@@ -952,7 +960,7 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         ok = np.asarray(ok)
         death = np.asarray(death)
         overflow = np.asarray(overflow)
-        counts = np.asarray(carry[9])
+        counts = np.asarray(carry[-2])
         # a key is decided if it consumed all entries or its frontier
         # died (death is definitive no matter how many entries remain)
         decided = (np.asarray(carry[0]) >= ns) | (counts == 0)
@@ -994,7 +1002,8 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
 
 
 def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
-                        frontier: int = 1024, slots: int = 32):
+                        frontier: int = 1024, slots: int = 32,
+                        engine: str = "auto"):
     """Shard a batch of independent histories across a device mesh and
     reduce the aggregate verdict with a psum-OR over ICI.
 
@@ -1017,19 +1026,29 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
         return True, np.zeros(0, bool)
     pad_k = -(-k // n_dev) * n_dev
 
-    entries_list = []
-    for h in hists:
-        ops = encode_ops_for_model(model, h)
-        entries_list.append(build_entries(ops, slots))
+    all_ops = [encode_ops_for_model(model, h) for h in hists]
+    # OpArray exposes the same f/a/b arrays _state_range reads, so
+    # eligibility costs no extra entry builds
+    srange = _state_range(name, model, all_ops)
+    dense = None
+    if engine in ("auto", "dense"):
+        dense = _dense_shape(
+            srange, max(required_slots(ops) for ops in all_ops))
+    if dense is not None:
+        slots = dense[2]
+    entries_list = [build_entries(ops, slots) for ops in all_ops]
     E = _bucket(max(max(e.n for e in entries_list), 1))
     padded = [e.pad_to(E) for e in entries_list]
     padded += [Entries.empty(E)] * (pad_k - k)
 
     from functools import partial
 
-    srange = _state_range(name, model, padded)
-    check_batch = _kernel(name, frontier, slots, E,
-                          _pack_params(srange, slots)).check_batch
+    if dense is not None:
+        check_batch = _dense_kernel(name, dense[0], dense[1],
+                                    dense[2], E).check_batch
+    else:
+        check_batch = _kernel(name, frontier, slots, E,
+                              _pack_params(srange, slots)).check_batch
 
     # check_vma=False: the kernel's inner lax loops create fresh constants
     # whose varying-manual-axes tags can't match the sharded carries; the

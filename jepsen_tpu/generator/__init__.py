@@ -790,6 +790,41 @@ def once(gen):
     return Limit(1, gen)
 
 
+class Derefer(Gen):
+    """Defer building a generator until it is first asked for an op —
+    the reference's `gen/derefer` over a delay (`aerospike
+    set.clj:63-72` uses it for final reads over keys only known at
+    runtime). The built generator is memoized on this node (a delay
+    caches its value), so a discarded poll re-polls the same state and
+    nothing is lost; each emitted op hands the advanced tail to a
+    fresh Derefer."""
+
+    def __init__(self, build: Callable):
+        self.build = build
+        self._built = _UNPULLED
+
+    def op(self, test, ctx):
+        if self._built is _UNPULLED:
+            self._built = self.build(test, ctx)
+        res = op(self._built, test, ctx)
+        if res is None:
+            return None
+        o, g1 = res
+        nxt = Derefer(self.build)
+        nxt._built = g1
+        return o, nxt
+
+    def update(self, test, ctx, event):
+        if self._built is not _UNPULLED:
+            self._built = update(self._built, test, ctx, event)
+        return self
+
+
+def derefer(build: Callable) -> Derefer:
+    """build(test, ctx) -> generator (or None), called at most once."""
+    return Derefer(build)
+
+
 def log(msg):
     """A one-shot op that just logs a message (`generator.clj:1177`)."""
     return {"type": "log", "value": msg}

@@ -13,7 +13,7 @@ import urllib.request
 
 SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
           "cockroach", "disque", "rabbitmq", "galera", "percona",
-          "stolon", "postgres_rds", "raftis", "mongodb")
+          "stolon", "postgres_rds", "raftis", "mongodb", "aerospike")
 
 
 def suite(name: str):
@@ -26,6 +26,7 @@ def suite(name: str):
 
 def std_test(opts: dict, *, name: str, db, workload: dict,
              os=None, default_faults=("partition",),
+             nemesis_package: dict | None = None,
              extra: dict | None = None) -> dict:
     """Assemble the standard suite test map: workload client/checker +
     nemesis package from opts['faults'] + staggered client generator
@@ -40,10 +41,16 @@ def std_test(opts: dict, *, name: str, db, workload: dict,
 
     faults = [f for f in (opts.get("faults") or list(default_faults))
               if f != "none"]
-    pkg = combined.nemesis_package({
-        "db": db, "faults": faults,
-        "interval": opts.get("nemesis-interval", 10)}) \
-        if faults else combined.noop
+    if nemesis_package is not None:
+        # suites with bespoke nemesis stacks (e.g. aerospike's capped
+        # kill + revive/recluster) supply the package whole
+        pkg = nemesis_package
+    elif faults:
+        pkg = combined.nemesis_package({
+            "db": db, "faults": faults,
+            "interval": opts.get("nemesis-interval", 10)})
+    else:
+        pkg = combined.noop
 
     rate = float(opts.get("rate", 10))
     time_limit = opts.get("time-limit", opts.get("time_limit", 60))

@@ -15,6 +15,7 @@ from typing import Any, Optional
 
 from . import client as jclient
 from . import nemesis as jnemesis
+from . import net as jnet
 from .checker import unbridled_optimism
 
 
@@ -101,6 +102,7 @@ def noop_test() -> dict:
         "concurrency": 5,
         "client": jclient.noop,
         "nemesis": jnemesis.noop,
+        "net": jnet.iptables,
         "generator": None,
         "checker": unbridled_optimism(),
     }

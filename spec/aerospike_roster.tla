@@ -1,0 +1,117 @@
+---- MODULE aerospike_roster ----
+(***************************************************************************)
+(* Formal model of the roster-based strong-consistency membership that    *)
+(* the aerospike suite's nemesis exercises (kill / partition / revive /   *)
+(* recluster — see jepsen_tpu/suites/aerospike.py, mirroring the          *)
+(* reference's aerospike/spec/aerospike.tla, modeled independently).      *)
+(*                                                                        *)
+(* Nodes share a static Roster.  Each live node holds a *view*: the set   *)
+(* of roster nodes it currently believes reachable.  A sub-cluster may    *)
+(* accept writes for a partition only if its view contains a strict       *)
+(* majority of the roster (or all replicas of the partition — we model    *)
+(* the coarser majority rule).  Kills remove nodes; partitions split      *)
+(* views; recluster recomputes views from current reachability; revive    *)
+(* readmits a dead namespace only after operator action.                  *)
+(*                                                                        *)
+(* Safety (WriteExclusivity): two disjoint views can never both be        *)
+(* write-authoritative — the property whose violation would surface as a  *)
+(* lost update or split-brain in the cas-register workload.               *)
+(***************************************************************************)
+
+EXTENDS Naturals, FiniteSets
+
+CONSTANT Roster            \* static set of nodes, e.g. {n1, n2, n3, n4, n5}
+CONSTANT MaxDead           \* nemesis cap on simultaneously-dead nodes
+
+VARIABLES
+  dead,        \* set of killed nodes (asd not running)
+  partition,   \* a set of sets: the connectivity components
+  view,        \* view[n]: the component n believed at last recluster
+  revived      \* set of nodes whose namespace was revived after death
+
+vars == <<dead, partition, view, revived>>
+
+Majority(S) == 2 * Cardinality(S) > Cardinality(Roster)
+
+Live == Roster \ dead
+
+ComponentOf(n) == CHOOSE c \in partition : n \in c
+
+TypeOK ==
+  /\ dead \subseteq Roster
+  /\ revived \subseteq Roster
+  /\ \A c \in partition : c \subseteq Roster
+  /\ UNION partition = Roster
+  /\ \A n \in Roster : view[n] \subseteq Roster
+
+Init ==
+  /\ dead = {}
+  /\ revived = Roster
+  /\ partition = {Roster}
+  /\ view = [n \in Roster |-> Roster]
+
+(* Nemesis: kill a node, respecting the max-dead cap                      *)
+Kill(n) ==
+  /\ n \in Live
+  /\ Cardinality(dead) < MaxDead
+  /\ dead' = dead \cup {n}
+  /\ revived' = revived \ {n}
+  /\ UNCHANGED <<partition, view>>
+
+(* Nemesis: restart a killed node; it rejoins with an empty view until    *)
+(* the next recluster                                                      *)
+Restart(n) ==
+  /\ n \in dead
+  /\ dead' = dead \ {n}
+  /\ view' = [view EXCEPT ![n] = {n}]
+  /\ UNCHANGED <<partition, revived>>
+
+(* Nemesis: partition the roster into two halves                          *)
+Partition(c) ==
+  /\ c \subseteq Roster /\ c # {} /\ c # Roster
+  /\ partition' = {c, Roster \ c}
+  /\ UNCHANGED <<dead, view, revived>>
+
+Heal ==
+  /\ partition' = {Roster}
+  /\ UNCHANGED <<dead, view, revived>>
+
+(* Operator: revive a restarted node's namespace                          *)
+Revive(n) ==
+  /\ n \in Live
+  /\ revived' = revived \cup {n}
+  /\ UNCHANGED <<dead, partition, view>>
+
+(* Operator: recluster — every live node recomputes its view as the live, *)
+(* revived members of its connectivity component                           *)
+Recluster ==
+  /\ view' = [n \in Roster |->
+                IF n \in Live THEN (ComponentOf(n) \cap Live) \cap revived
+                ELSE view[n]]
+  /\ UNCHANGED <<dead, partition, revived>>
+
+Next ==
+  \/ \E n \in Roster : Kill(n) \/ Restart(n) \/ Revive(n)
+  \/ \E c \in SUBSET Roster : Partition(c)
+  \/ Heal
+  \/ Recluster
+
+Spec == Init /\ [][Next]_vars
+
+(* A view is write-authoritative iff it holds a roster majority and all   *)
+(* its members are live and mutually reachable                            *)
+Authoritative(n) ==
+  /\ n \in Live
+  /\ Majority(view[n])
+  /\ view[n] \subseteq (ComponentOf(n) \cap Live)
+
+(* Two authoritative nodes must share a view member: no disjoint          *)
+(* sub-clusters may both accept writes                                     *)
+WriteExclusivity ==
+  \A m, n \in Roster :
+    (Authoritative(m) /\ Authoritative(n)) =>
+      (view[m] \cap view[n]) # {}
+
+THEOREM Spec => [](TypeOK /\ WriteExclusivity)
+
+====

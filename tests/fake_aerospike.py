@@ -1,0 +1,163 @@
+"""In-process fake Aerospike server speaking the wire subset in
+`jepsen_tpu/suites/as_proto.py`: message protocol (get / put with
+generation and create-only policies / append / incr) and the text info
+protocol. Single consistent store — the fake is a *correct* server, so
+valid workloads must check valid."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from jepsen_tpu.suites import as_proto as p
+
+
+class FakeAerospike:
+    def __init__(self):
+        self.store: dict[tuple, dict] = {}   # (ns,set,key) -> record
+        self.lock = threading.Lock()
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(64)
+        self.port = self.srv.getsockname()[1]
+        self.running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def stop(self):
+        self.running = False
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+    def _accept(self):
+        while self.running:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _read_exact(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        try:
+            while True:
+                proto, = struct.unpack(">Q", self._read_exact(conn, 8))
+                size = proto & ((1 << 48) - 1)
+                ptype = (proto >> 48) & 0xFF
+                payload = self._read_exact(conn, size)
+                if ptype == p.T_INFO:
+                    reply = self._info(payload)
+                    hdr = struct.pack(
+                        ">Q", (2 << 56) | (p.T_INFO << 48) | len(reply))
+                    conn.sendall(hdr + reply)
+                else:
+                    conn.sendall(self._message(payload))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _info(self, payload: bytes) -> bytes:
+        out = []
+        for cmd in payload.decode().splitlines():
+            if not cmd:
+                continue
+            if cmd == "status":
+                out.append("status\tok")
+            elif cmd.startswith("roster:"):
+                out.append(f"{cmd}\troster=null:pending_roster=null:"
+                           f"observed_nodes=null")
+            elif cmd.startswith(("recluster", "revive")):
+                out.append(f"{cmd}\tok")
+            else:
+                out.append(f"{cmd}\tunknown")
+        return ("\n".join(out) + "\n").encode()
+
+    def _message(self, payload: bytes) -> bytes:
+        rc, gen_in, fields, (i1, i2, i3), bins_in = \
+            p.decode_message(payload)
+        fmap = dict(fields)
+        ns = fmap.get(p.FIELD_NAMESPACE, b"").decode()
+        st = fmap.get(p.FIELD_SET, b"").decode()
+        kb = fmap.get(p.FIELD_KEY, b"\x01")
+        key = p._decode_value(kb[0], kb[1:])
+        k = (ns, st, key)
+
+        def reply(code, generation=0, bins=None):
+            ops = [p._op(p.OP_READ, name, v)
+                   for name, v in (bins or {}).items()]
+            return p.encode_message(0, 0, 0, generation, [], ops,
+                                    result_code=code)
+
+        with self.lock:
+            rec = self.store.get(k)
+            if i1 & p.INFO1_READ:
+                if rec is None:
+                    return reply(p.RC_KEY_NOT_FOUND)
+                return reply(p.RC_OK, rec["generation"],
+                             dict(rec["bins"]))
+            if i2 & p.INFO2_WRITE:
+                if i2 & p.INFO2_CREATE_ONLY and rec is not None:
+                    return reply(p.RC_KEY_EXISTS)
+                if i2 & p.INFO2_GENERATION and \
+                        (rec is None or rec["generation"] != gen_in):
+                    return reply(p.RC_GENERATION)
+                if rec is None:
+                    rec = {"generation": 0, "bins": {}}
+                    self.store[k] = rec
+                # bins_in values decoded by decode_message; op types are
+                # lost there, so the client re-encodes intent via the
+                # per-op type byte — recover it from the raw payload
+                for op_type, name, value in _ops(payload):
+                    if op_type == p.OP_WRITE:
+                        rec["bins"][name] = value
+                    elif op_type == p.OP_APPEND:
+                        cur = rec["bins"].get(name, "")
+                        if not isinstance(cur, str) \
+                                or not isinstance(value, str):
+                            return reply(p.RC_PARAMETER)
+                        rec["bins"][name] = cur + value
+                    elif op_type == p.OP_INCR:
+                        cur = rec["bins"].get(name, 0)
+                        if not isinstance(cur, int) \
+                                or not isinstance(value, int):
+                            return reply(p.RC_PARAMETER)
+                        rec["bins"][name] = cur + value
+                    else:
+                        return reply(p.RC_PARAMETER)
+                rec["generation"] += 1
+                return reply(p.RC_OK, rec["generation"])
+        return reply(p.RC_PARAMETER)
+
+
+def _ops(payload: bytes):
+    """Yield (op_type, bin_name, value) from a raw message payload."""
+    (hsz, _i1, _i2, _i3, _u, _rc, _gen, _exp, _ttl,
+     n_fields, n_ops) = struct.unpack(">BBBBBBIIIHH", payload[:22])
+    off = hsz
+    for _ in range(n_fields):
+        sz, = struct.unpack(">I", payload[off:off + 4])
+        off += 4 + sz
+    for _ in range(n_ops):
+        sz, = struct.unpack(">I", payload[off:off + 4])
+        op_type, pt, _ver, nlen = struct.unpack(
+            ">BBBB", payload[off + 4:off + 8])
+        name = payload[off + 8:off + 8 + nlen].decode()
+        vdata = payload[off + 8 + nlen:off + 4 + sz]
+        yield op_type, name, p._decode_value(pt, vdata)
+        off += 4 + sz
