@@ -265,6 +265,12 @@ class Linearizable(Checker):
                 except ImportError:
                     if algo == "tpu":
                         raise
+                except ValueError:
+                    # history exceeds the device encoding (e.g. g-set
+                    # elements beyond the bitmask, crashed queue
+                    # dequeues): the host model handles it
+                    if algo == "tpu":
+                        raise
             elif algo == "tpu":
                 return {"valid?": UNKNOWN,
                         "error": f"model {self.model!r} has no device form"}

@@ -104,6 +104,159 @@ def mutex_codec(o: dict) -> tuple[int, int, int]:
     raise ValueError(f"unknown mutex op f={f!r}")
 
 
+# -- counter: f 0 = read(observed; b=1 iff constrained), 1 = add(delta) ------
+# Counters reach negative values routinely, so an observed read of -1
+# must NOT collide with the NIL sentinel: b carries an explicit
+# "constrained" flag instead.
+
+def _counter_step(state, f, a, b):
+    import jax.numpy as jnp
+    state, f, a, b = jnp.broadcast_arrays(state, f, a, b)
+    legal = ((f == 0) & ((b == 0) | (state == a))) | (f == 1)
+    new = jnp.where(f == 1, state + a, state)
+    return legal, new
+
+
+def counter_codec(o: dict) -> tuple[int, int, int]:
+    f, v = o["f"], o["value"]
+    if f == "read":
+        if v is None:
+            return 0, 0, 0
+        return 0, int(v), 1
+    if f == "add":
+        return 1, int(v), NIL
+    raise ValueError(f"unknown counter op f={f!r}")
+
+
+def _counter_range(init, f, a, b):
+    f, a, b = np.asarray(f), np.asarray(a), np.asarray(b)
+    deltas = a[f == 1]
+    lo = init + int(deltas[deltas < 0].sum()) if deltas.size else init
+    hi = init + int(deltas[deltas > 0].sum()) if deltas.size else init
+    # completed reads also name reachable values (paranoia: they must
+    # equal a state anyway); include them so invalid histories still
+    # encode
+    reads = a[(f == 0) & (b == 1)]
+    if reads.size:
+        lo = min(lo, int(reads.min()))
+        hi = max(hi, int(reads.max()))
+    return lo, hi
+
+
+# -- grow-only set: f 0 = read(bitmask), 1 = add(element id) -----------------
+
+GSET_MAX_ELEMENTS = 31   # state is an int32 membership bitmask
+
+
+def _gset_step(state, f, a, b):
+    import jax.numpy as jnp
+    state, f, a = jnp.broadcast_arrays(state, f, a)
+    legal = ((f == 0) & ((a == NIL) | (state == a))) | (f == 1)
+    shift = jnp.clip(a, 0, GSET_MAX_ELEMENTS - 1)
+    new = jnp.where(f == 1, state | (1 << shift), state)
+    return legal, new
+
+
+def gset_codec(o: dict) -> tuple[int, int, int]:
+    f, v = o["f"], o["value"]
+    if f == "add":
+        v = int(v)
+        if not 0 <= v < GSET_MAX_ELEMENTS:
+            raise ValueError(
+                f"g-set element {v} outside [0, {GSET_MAX_ELEMENTS})"
+                " — use the host model")
+        return 1, v, NIL
+    if f == "read":
+        if v is None:
+            return 0, NIL, NIL
+        mask = 0
+        for x in v:
+            x = int(x)
+            if not 0 <= x < GSET_MAX_ELEMENTS:
+                raise ValueError(
+                    f"g-set element {x} outside "
+                    f"[0, {GSET_MAX_ELEMENTS}) — use the host model")
+            mask |= 1 << x
+        return 0, mask, NIL
+    raise ValueError(f"unknown g-set op f={f!r}")
+
+
+def _gset_range(init, f, a, b):
+    f, a = np.asarray(f), np.asarray(a)
+    full = int(init)
+    for x in a[f == 1]:
+        full |= 1 << int(x)
+    for m in a[(f == 0) & (a != NIL)]:
+        full |= int(m)
+    return 0, full
+
+
+# -- unordered queue: f 0 = dequeue(v), 1 = enqueue(v) -----------------------
+# state: 4-bit per-value multiplicities, values in [0, 7)
+
+UQ_VALUES = 7
+UQ_COUNT_MAX = 15
+
+
+def _uqueue_step(state, f, a, b):
+    import jax.numpy as jnp
+    state, f, a = jnp.broadcast_arrays(state, f, a)
+    shift = 4 * jnp.clip(a, 0, UQ_VALUES - 1)
+    cnt = (state >> shift) & UQ_COUNT_MAX
+    ok_a = (a >= 0) & (a < UQ_VALUES)
+    legal = jnp.where(f == 1, ok_a & (cnt < UQ_COUNT_MAX),
+                      ok_a & (cnt > 0))
+    new = jnp.where(legal & (f == 1), state + (1 << shift),
+                    jnp.where(legal & (f == 0),
+                              state - (1 << shift), state))
+    return legal, new
+
+
+def _uqueue_validate(ops: OpArray) -> None:
+    """A sound upper bound on any reachable per-value multiplicity:
+    enqueues invoked so far minus ok dequeues returned so far, maxed
+    over the event stream. If it can exceed the 4-bit digit cap the
+    device multiset would silently saturate and report a false
+    invalid — raise so the checker falls back to the host model."""
+    events: list[tuple[int, int, int]] = []
+    for r in range(len(ops)):
+        v = int(ops.a[r])
+        if ops.f[r] == 1:                       # enqueue (incl. crashed)
+            events.append((int(ops.inv[r]), 0, v))
+        elif ops.kind[r] == KIND_OK:            # ok dequeue
+            events.append((int(ops.ret[r]), 1, v))
+    events.sort()
+    outstanding = [0] * UQ_VALUES
+    for _, kind, v in events:
+        if kind == 0:
+            outstanding[v] += 1
+            if outstanding[v] > UQ_COUNT_MAX:
+                raise ValueError(
+                    f"queue value {v} may have more than "
+                    f"{UQ_COUNT_MAX} outstanding copies — the device "
+                    "multiset digit would saturate; use the host model")
+        else:
+            outstanding[v] -= 1
+
+
+def uqueue_codec(o: dict) -> tuple[int, int, int]:
+    f, v = o["f"], o["value"]
+    if v is None:
+        raise ValueError(
+            "queue op with unknown value (crashed dequeue?) — the "
+            "device multiset can't branch over it; use the host model")
+    v = int(v)
+    if not 0 <= v < UQ_VALUES:
+        raise ValueError(
+            f"queue value {v} outside [0, {UQ_VALUES}) — use the "
+            "host model")
+    if f == "enqueue":
+        return 1, v, NIL
+    if f == "dequeue":
+        return 0, v, NIL
+    raise ValueError(f"unknown queue op f={f!r}")
+
+
 @dataclasses.dataclass(frozen=True)
 class DeviceModel:
     """A model with enumerable int32 state, steppable on device.
@@ -119,6 +272,7 @@ class DeviceModel:
     codec: Callable
     droppable: frozenset
     state_range: Callable
+    validate: Callable | None = None  # OpArray -> None | raise ValueError
 
     def __iter__(self):  # legacy tuple shape: (step, codec, droppable)
         return iter((self.step, self.codec, self.droppable))
@@ -142,6 +296,16 @@ DEVICE_MODELS: dict[str, DeviceModel] = {
                             frozenset({F_READ}), _register_range),
     "mutex": DeviceModel(_mutex_step, mutex_codec, frozenset(),
                          lambda init, f, a, b: (0, 1)),
+    # crashed (pending) reads constrain nothing for counter/g-set and
+    # are droppable; queue dequeues are never droppable
+    "counter": DeviceModel(_counter_step, counter_codec,
+                           frozenset({0}), _counter_range),
+    "g-set": DeviceModel(_gset_step, gset_codec,
+                         frozenset({0}), _gset_range),
+    "unordered-queue": DeviceModel(
+        _uqueue_step, uqueue_codec, frozenset(),
+        lambda init, f, a, b: (0, (1 << (4 * UQ_VALUES)) - 1),
+        validate=_uqueue_validate),
 }
 
 
@@ -710,12 +874,17 @@ def _dense_shape(srange: tuple[int, int],
 
 def encode_ops_for_model(model, hist) -> OpArray:
     """Encode a history with the model's value codec, honoring the model's
-    rules about which pending ops are droppable."""
+    rules about which pending ops are droppable. Raises ValueError when
+    the history exceeds the device encoding (checkers fall back to the
+    host model)."""
     name = model.device_model
     if name is None or name not in DEVICE_MODELS:
         raise ValueError(f"model {model!r} has no device form")
-    _, codec, droppable = DEVICE_MODELS[name]
-    return encode_ops(as_history(hist), codec, droppable)
+    dm = DEVICE_MODELS[name]
+    ops = encode_ops(as_history(hist), dm.codec, dm.droppable)
+    if dm.validate is not None:
+        dm.validate(ops)
+    return ops
 
 
 def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,

@@ -140,10 +140,86 @@ class NoOp(Model):
 
 
 @dataclasses.dataclass(frozen=True)
+class Counter(Model):
+    """A counter: adds always apply, reads must observe the current
+    value (knossos's counter model family; the reference offloads
+    counter checking to the O(n) bounds checker, `checker.clj:737-795`
+    — this model makes it *linearizability*-checkable on device)."""
+    value: int = 0
+
+    device_model = "counter"
+
+    def step(self, op: dict):
+        f, v = op["f"], op["value"]
+        if f == "add":
+            return Counter(self.value + int(v))
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(
+                f"read {v!r} but counter is {self.value}")
+        return inconsistent(f"unknown op f={f!r}")
+
+    def device_state(self) -> int:
+        return self.value
+
+
+@dataclasses.dataclass(frozen=True)
+class GSet(Model):
+    """A grow-only set: adds accumulate, reads observe the exact
+    current membership (the CRDT G-Set the hazelcast suite's map
+    workload exercises, `hazelcast.clj:652-767`)."""
+    members: frozenset = frozenset()
+
+    device_model = "g-set"
+
+    def step(self, op: dict):
+        f, v = op["f"], op["value"]
+        if f == "add":
+            return GSet(self.members | {v})
+        if f == "read":
+            if v is None or frozenset(v) == self.members:
+                return self
+            return inconsistent(
+                f"read {sorted(v)!r} but set is "
+                f"{sorted(self.members)!r}")
+        return inconsistent(f"unknown op f={f!r}")
+
+    def device_state(self) -> int:
+        state = 0
+        for v in self.members:
+            v = int(v)
+            if not 0 <= v < 31:
+                raise ValueError(
+                    f"g-set element {v} outside the device bitmask "
+                    "[0, 31) — use the host model")
+            state |= 1 << v
+        return state
+
+
+@dataclasses.dataclass(frozen=True)
 class UnorderedQueue(Model):
     """A queue where dequeues may return any enqueued-but-not-yet-dequeued
-    element (knossos unordered-queue). State is a frozen multiset."""
+    element (knossos unordered-queue). State is a frozen multiset.
+
+    Device form: the multiset packs into an int32 as 4-bit per-value
+    counts when values are ints in [0, 7) and multiplicities stay
+    under 16 — enough for token/CP-menu queue workloads; anything
+    wider falls back to this host model."""
     pending: frozenset = frozenset()  # of (value, dup-count) expanded pairs
+
+    device_model = "unordered-queue"
+
+    def device_state(self) -> int:
+        state = 0
+        for (v, _i) in self.pending:
+            v = int(v)
+            if not 0 <= v < 7:
+                raise ValueError(
+                    f"queue value {v} outside the device digit range "
+                    "[0, 7) — use the host model")
+            state += 1 << (4 * v)
+        return state
 
     @staticmethod
     def _add(pending: frozenset, v: Any) -> frozenset:
@@ -202,6 +278,14 @@ def mutex() -> Mutex:
 
 def noop() -> NoOp:
     return NoOp()
+
+
+def counter(value: int = 0) -> Counter:
+    return Counter(value)
+
+
+def gset() -> GSet:
+    return GSet()
 
 
 def unordered_queue() -> UnorderedQueue:

@@ -482,7 +482,12 @@ def counter_workload(opts) -> dict:
     return {
         "client": CounterClient(),
         "generator": gen.mix([add] * 100 + [r]),
-        "checker": checker.counter(),
+        # the O(n) bounds checker (reference behavior) plus full
+        # linearizability against the device counter model
+        "checker": checker.compose({
+            "counter": checker.counter(),
+            "linear": linear.linearizable(models.counter()),
+        }),
     }
 
 

@@ -96,6 +96,31 @@ class CPState:
         q = self.queues.get(req["name"]) or []
         return {"ok": True, "value": q.pop(0) if q else None}
 
+    def op_queue_poll_value(self, req):
+        """Remove one instance of a specific value (an unordered
+        dequeue, for the queue-linear workload)."""
+        q = self.queues.get(req["name"]) or []
+        if not q:
+            return {"ok": True, "value": None}
+        import random
+        v = random.choice(q)
+        q.remove(v)
+        return {"ok": True, "value": v}
+
+    # maps (the reference's map / crdt-map workloads,
+    # `hazelcast.clj:440-507`: a set stored under one map key) --------------
+
+    def op_map_add(self, req):
+        m = self.queues.setdefault("map:" + req["name"], [])
+        if req["value"] not in m:
+            m.append(req["value"])
+        return {"ok": True}
+
+    def op_map_read(self, req):
+        return {"ok": True,
+                "value": sorted(self.queues.get("map:" + req["name"])
+                                or [])}
+
 
 def serve(host: str = "127.0.0.1", port: int = 0):
     """Run the shim in a daemon thread; returns (server, port)."""

@@ -35,7 +35,9 @@ def shim_url(node: str) -> str:
 
 
 class DB(jdb.DB, jdb.LogFiles):
-    """Deploys the CP service shim on each node."""
+    """Deploys the CP service shim on each node — the hermetic tier.
+    The shim is linearizable by construction; use ServerDB (--deploy
+    server) to test actual Hazelcast members."""
 
     def setup(self, test, node):
         cp_shim.deploy(test.get("shim-port", cp_shim.PORT))
@@ -48,6 +50,56 @@ class DB(jdb.DB, jdb.LogFiles):
 
     def log_files(self, test, node):
         return [f"{cp_shim.DIR}/shim.log"]
+
+
+SERVER_DIR = "/opt/hazelcast"
+SERVER_JAR = f"{SERVER_DIR}/server.jar"
+SERVER_PID = f"{SERVER_DIR}/server.pid"
+SERVER_LOG = f"{SERVER_DIR}/server.log"
+MEMBER_PORT = 5701
+
+
+class ServerDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Real Hazelcast members: upload the server fat jar (the
+    reference builds `hazelcast/server/target/hazelcast-server.jar`
+    via lein and uploads it, `hazelcast.clj:57-96`), install a JDK,
+    and run `java -jar server.jar --members ip,ip,...` as a daemon."""
+
+    def __init__(self, server_jar: str | None = None):
+        self.server_jar = server_jar
+
+    def setup(self, test, node):
+        from ..control import util as cu
+        jar = test.get("server-jar") or self.server_jar
+        assert jar, "ServerDB needs a server jar (--server-jar)"
+        with control.su():
+            debian.install_jdk11()
+            control.exec_("mkdir", "-p", SERVER_DIR)
+            control.upload(jar, SERVER_JAR)
+            self.start(test, node)
+            cu.await_tcp_port(MEMBER_PORT)
+
+    def start(self, test, node):
+        from ..control import util as cu
+        with control.su():
+            cu.start_daemon(
+                {"chdir": SERVER_DIR, "logfile": SERVER_LOG,
+                 "pidfile": SERVER_PID},
+                "/usr/bin/java", "-jar", SERVER_JAR,
+                "--members", ",".join(test["nodes"]))
+
+    def kill(self, test, node):
+        from ..control import util as cu
+        with control.su():
+            cu.stop_daemon(SERVER_PID, cmd="java")
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        with control.su():
+            control.exec_("rm", "-rf", SERVER_LOG, SERVER_PID)
+
+    def log_files(self, test, node):
+        return [SERVER_LOG]
 
 
 class CPClient(jclient.Client):
@@ -133,13 +185,15 @@ class IdClient(CPClient):
 
 
 class QueueClient(CPClient):
+    POLL = "/queue/poll"
+
     def apply_op(self, test, op):
         if op["f"] == "enqueue":
             self.post("/queue/offer", {"name": "jepsen",
                                        "value": op["value"]})
             return {**op, "type": "ok"}
         if op["f"] == "dequeue":
-            r = self.post("/queue/poll", {"name": "jepsen"})
+            r = self.post(self.POLL, {"name": "jepsen"})
             if r["value"] is None:
                 return {**op, "type": "fail", "error": "empty"}
             return {**op, "type": "ok", "value": r["value"]}
@@ -244,12 +298,103 @@ def queue_workload(opts):
                 {"type": "invoke", "f": "drain", "value": None}))}
 
 
+class UnorderedQueueClient(QueueClient):
+    """Dequeues any element (not FIFO head) so the history is judged
+    against the unordered-queue model."""
+    POLL = "/queue/poll/value"
+
+
+def queue_linear_workload(opts):
+    """Queue over a small value domain, checked as full
+    linearizability against the unordered-queue device model — the
+    knossos-model usage the reference gets from hazelcast's queue
+    tests (`hazelcast.clj` queue + knossos models)."""
+    def enq(test, ctx):
+        return {"type": "invoke", "f": "enqueue",
+                "value": gen.rng.randrange(5)}
+
+    def deq(test, ctx):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    return {"client": UnorderedQueueClient(),
+            "generator": gen.mix([enq, deq]),
+            "checker": checker.linearizable(models.unordered_queue()),
+            "final-generator": None}
+
+
+class MapClient(CPClient):
+    """The reference's map / crdt-map workloads: a set stored under
+    one map key; `add` merges an element, the final `read` fetches the
+    set (`hazelcast.clj:440-507`). crdt toggles which map the server
+    uses (PN-counter-backed CRDT vs plain)."""
+
+    READS = ("read",)
+    NAME = "jepsen-map"
+
+    def __init__(self, timeout_s: float = 5.0, url: str | None = None,
+                 owner: str | None = None, crdt: bool = True):
+        super().__init__(timeout_s, url, owner)
+        self.crdt = crdt
+
+    def open(self, test, node):
+        c = super().open(test, node)
+        c.crdt = self.crdt
+        return c
+
+    def _name(self):
+        return ("crdt:" if self.crdt else "") + self.NAME
+
+    def apply_op(self, test, op):
+        if op["f"] == "add":
+            self.post("/map/add", {"name": self._name(),
+                                   "value": op["value"]})
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            r = self.post("/map/read", {"name": self._name()})
+            return {**op, "type": "ok", "value": r["value"]}
+        raise ValueError(op["f"])
+
+
+def map_workload(opts, crdt: bool):
+    values = itertools.count()
+
+    def add(test, ctx):
+        return {"type": "invoke", "f": "add", "value": next(values)}
+
+    return {"client": MapClient(crdt=crdt),
+            "generator": add,
+            "checker": checker.set_checker(),
+            "final-generator": gen.each_thread(gen.once(
+                {"type": "invoke", "f": "read", "value": None}))}
+
+
+def gset_linear_workload(opts):
+    """CRDT map over a bounded element domain, checked as full
+    linearizability against the g-set device model (duplicate adds are
+    idempotent and legal)."""
+    def add(test, ctx):
+        return {"type": "invoke", "f": "add",
+                "value": gen.rng.randrange(16)}
+
+    def read(test, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    return {"client": MapClient(crdt=True),
+            "generator": gen.mix([add, add, read]),
+            "checker": checker.linearizable(models.gset()),
+            "final-generator": None}
+
+
 WORKLOADS = {
     "lock": lock_workload,
     "semaphore": semaphore_workload,
     "cas-register": cas_workload,
     "unique-ids": ids_workload,
     "queue": queue_workload,
+    "queue-linear": queue_linear_workload,
+    "map": lambda opts: map_workload(opts, crdt=False),
+    "crdt-map": lambda opts: map_workload(opts, crdt=True),
+    "crdt-map-linear": gset_linear_workload,
 }
 
 
@@ -279,7 +424,8 @@ def hazelcast_test(opts: dict) -> dict:
         **{k: v for k, v in opts.items() if isinstance(k, str)},
         "name": f"hazelcast-{name}",
         "os": debian.os,
-        "db": DB(),
+        "db": (ServerDB(opts.get("server-jar"))
+               if opts.get("deploy") == "server" else DB()),
         "client": workload["client"],
         "nemesis": partition.partition_majorities_ring()
         if opts.get("nemesis", "partition") == "partition"
@@ -303,6 +449,11 @@ OPT_SPEC = [
             help="semaphore capacity"),
     cli.opt("--nemesis", default="partition",
             choices=["partition", "none"], help="fault to inject"),
+    cli.opt("--deploy", default="shim", choices=["shim", "server"],
+            help="shim = hermetic CP service; server = real Hazelcast "
+                 "members from --server-jar"),
+    cli.opt("--server-jar", default=None,
+            help="path to the Hazelcast server fat jar to upload"),
 ]
 
 

@@ -72,7 +72,30 @@ def test_semaphore_checker():
 
 def test_menu_names():
     assert set(hazelcast.WORKLOADS) == \
-        {"lock", "semaphore", "cas-register", "unique-ids", "queue"}
+        {"lock", "semaphore", "cas-register", "unique-ids", "queue",
+         "queue-linear", "map", "crdt-map", "crdt-map-linear"}
+
+
+def test_server_db_commands(tmp_path):
+    """The real-server install path uploads the fat jar and daemonizes
+    java -jar --members (hazelcast.clj:70-96)."""
+    from jepsen_tpu import control
+    from jepsen_tpu.control import dummy
+    jar = tmp_path / "hazelcast-server.jar"
+    jar.write_bytes(b"jar")
+    log = []
+    remote = dummy.remote(log=log)
+    test = {"nodes": ["n1", "n2", "n3"], "server-jar": str(jar)}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            hazelcast.ServerDB().setup(test, "n1")
+            hazelcast.ServerDB().teardown(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "/usr/bin/java" in cmds and "-jar" in cmds
+    assert "--members n1,n2,n3" in cmds
+    assert "/opt/hazelcast/server.jar" in str(remote.files) \
+        or "server.jar" in cmds
 
 
 @pytest.mark.parametrize("workload", sorted(hazelcast.WORKLOADS))

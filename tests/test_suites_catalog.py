@@ -286,9 +286,15 @@ def test_stolon_db_commands():
 def test_stolon_hermetic_run(tmp_path, workload):
     f = FakePGServer()
     try:
+        # accounts 0-3 and rate 300: enough transfer attempts that at
+        # least one lands on a funded account even on a slow loaded
+        # run — with 8 accounts and ~15 ops, all transfers can
+        # legitimately fail (insufficient funds) and the stats checker
+        # correctly flags an op type with zero oks
         t = stolon.stolon_test({
             "nodes": ["n1", "n2", "n3"], "concurrency": 3,
-            "ssh": {"dummy": True}, "workload": workload, "rate": 100,
+            "ssh": {"dummy": True}, "workload": workload, "rate": 300,
+            "accounts": [0, 1, 2, 3],
             "time-limit": 3, "faults": ["none"]})
         done = _hermetic(t, "sql-conn-fn",
                          lambda n: PgConn("127.0.0.1", f.port),

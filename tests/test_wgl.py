@@ -406,3 +406,130 @@ def test_negative_register_values():
     for engine in ("dense", "sort"):
         a = analysis_tpu(models.cas_register(), History(bad), engine=engine)
         assert a["valid?"] is False, (engine, a)
+
+
+# -- new device models: counter / g-set / unordered queue --------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_counter_device_host_agreement(seed):
+    h = synth.counter_history(120, concurrency=4, crash_rate=0.05,
+                              seed=seed)
+    d = analysis_tpu(m.counter(), h)
+    host = analysis_host(m.counter(), h)
+    assert d["valid?"] is host["valid?"] is True, (d, host)
+
+
+def test_counter_catches_bad_read():
+    h = [op("invoke", "add", 2, 0), op("ok", "add", 2, 0),
+         op("invoke", "read", None, 0), op("ok", "read", 5, 0)]
+    d = analysis_tpu(m.counter(), History(h))
+    host = analysis_host(m.counter(), History(h))
+    assert d["valid?"] is host["valid?"] is False
+
+
+def test_counter_concurrent_add_read_window():
+    # a read overlapping an add may see either value
+    h = [op("invoke", "add", 1, 0),
+         op("invoke", "read", None, 1), op("ok", "read", 1, 1),
+         op("ok", "add", 1, 0),
+         op("invoke", "read", None, 1), op("ok", "read", 1, 1)]
+    assert analysis_tpu(m.counter(), History(h))["valid?"] is True
+    h2 = [op("invoke", "add", 1, 0),
+          op("invoke", "read", None, 1), op("ok", "read", 0, 1),
+          op("ok", "add", 1, 0)]
+    assert analysis_tpu(m.counter(), History(h2))["valid?"] is True
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_gset_device_host_agreement(seed):
+    h = synth.gset_history(120, concurrency=4, seed=seed)
+    d = analysis_tpu(m.gset(), h)
+    host = analysis_host(m.gset(), h)
+    assert d["valid?"] is host["valid?"] is True, (d, host)
+
+
+def test_gset_catches_phantom_and_lost_elements():
+    lost = [op("invoke", "add", 3, 0), op("ok", "add", 3, 0),
+            op("invoke", "read", None, 0), op("ok", "read", [], 0)]
+    assert analysis_tpu(m.gset(), History(lost))["valid?"] is False
+    phantom = [op("invoke", "add", 3, 0), op("ok", "add", 3, 0),
+               op("invoke", "read", None, 0),
+               op("ok", "read", [3, 4], 0)]
+    assert analysis_tpu(m.gset(), History(phantom))["valid?"] is False
+
+
+def test_gset_large_elements_fall_back_to_host():
+    from jepsen_tpu.checker.linear import linearizable
+    h = [op("invoke", "add", 1000, 0), op("ok", "add", 1000, 0),
+         op("invoke", "read", None, 0), op("ok", "read", [1000], 0)]
+    r = linearizable(m.gset()).check({}, History(h), {})
+    assert r["valid?"] is True
+    assert r["analyzer"] == "host-jit-linear"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_uqueue_device_host_agreement(seed):
+    h = synth.uqueue_history(120, concurrency=4, seed=seed)
+    d = analysis_tpu(m.unordered_queue(), h)
+    host = analysis_host(m.unordered_queue(), h)
+    assert d["valid?"] is host["valid?"] is True, (d, host)
+
+
+def test_uqueue_catches_phantom_dequeue():
+    h = [op("invoke", "enqueue", 1, 0), op("ok", "enqueue", 1, 0),
+         op("invoke", "dequeue", None, 1), op("ok", "dequeue", 2, 1)]
+    d = analysis_tpu(m.unordered_queue(), History(h))
+    host = analysis_host(m.unordered_queue(), History(h))
+    assert d["valid?"] is host["valid?"] is False
+
+
+def test_uqueue_unordered_ok():
+    # dequeue order need not match enqueue order
+    h = [op("invoke", "enqueue", 1, 0), op("ok", "enqueue", 1, 0),
+         op("invoke", "enqueue", 2, 0), op("ok", "enqueue", 2, 0),
+         op("invoke", "dequeue", None, 1), op("ok", "dequeue", 2, 1),
+         op("invoke", "dequeue", None, 1), op("ok", "dequeue", 1, 1)]
+    assert analysis_tpu(m.unordered_queue(), History(h))["valid?"] is True
+
+
+def test_uqueue_crashed_dequeue_falls_back_to_host():
+    from jepsen_tpu.checker.linear import linearizable
+    h = [op("invoke", "enqueue", 1, 0), op("ok", "enqueue", 1, 0),
+         op("invoke", "dequeue", None, 1), op("info", "dequeue", None, 1)]
+    r = linearizable(m.unordered_queue()).check({}, History(h), {})
+    assert r["valid?"] in (True, False)
+    assert r["analyzer"] == "host-jit-linear"
+
+
+def test_counter_negative_read_value_not_confused_with_nil():
+    """An observed read of -1 must constrain the search (it is NOT the
+    NIL 'unconstrained' sentinel) — false-valid regression."""
+    bad = [op("invoke", "read", None, 0), op("ok", "read", -1, 0)]
+    d = analysis_tpu(m.counter(), History(bad))
+    host = analysis_host(m.counter(), History(bad))
+    assert d["valid?"] is host["valid?"] is False
+    good = [op("invoke", "add", -1, 0), op("ok", "add", -1, 0),
+            op("invoke", "read", None, 0), op("ok", "read", -1, 0)]
+    assert analysis_tpu(m.counter(), History(good))["valid?"] is True
+
+
+def test_uqueue_multiplicity_overflow_falls_back_to_host():
+    """16+ outstanding copies of one value would saturate the device
+    digit and report a false invalid — must fall back to the host."""
+    from jepsen_tpu.checker.linear import linearizable
+    h = []
+    for i in range(20):
+        h.append(op("invoke", "enqueue", 1, 0))
+        h.append(op("ok", "enqueue", 1, 0))
+    r = linearizable(m.unordered_queue()).check({}, History(h), {})
+    assert r["valid?"] is True
+    assert r["analyzer"] == "host-jit-linear"
+
+
+def test_gset_out_of_range_initial_state_falls_back():
+    from jepsen_tpu.checker.linear import linearizable
+    model = m.GSet(frozenset({40}))
+    h = [op("invoke", "read", None, 0), op("ok", "read", [40], 0)]
+    r = linearizable(model).check({}, History(h), {})
+    assert r["valid?"] is True
+    assert r["analyzer"] == "host-jit-linear"
