@@ -171,6 +171,24 @@ def main() -> int:
 
     extra["configs"] = configs
 
+    # ---- generator throughput (reference: >20k ops/s single-thread,
+    # generator.clj:66-70) ----
+    _note("generator throughput")
+    import random as _random
+
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.generator import simulate
+    rng = _random.Random(45100)
+    n_gen = 50_000
+    g = gen.clients(gen.limit(n_gen, gen.mix([
+        lambda: {"f": "read"},
+        lambda: {"f": "write", "value": rng.randint(0, 4)},
+    ])))
+    t0 = time.monotonic()
+    simulate.quick(gen.context({"concurrency": 10}), g)
+    extra["generator_ops_per_s"] = round(
+        n_gen / (time.monotonic() - t0), 1)
+
     print(json.dumps({
         "metric": ("linearizability verification throughput, 10k-op "
                    "concurrent CAS-register history (WGL search)"),

@@ -26,8 +26,8 @@ import time as _time
 from typing import Any
 
 from .. import models as m
-from ..history import History, history as as_history, is_fail, is_info, \
-    is_invoke, is_ok
+from ..history import DeviceEncodingError, History, \
+    history as as_history, is_fail, is_info, is_invoke, is_ok
 from . import Checker, UNKNOWN
 
 
@@ -265,17 +265,19 @@ class Linearizable(Checker):
                 except ImportError:
                     if algo == "tpu":
                         raise
-                except ValueError:
+                except DeviceEncodingError:
                     # history exceeds the device encoding (e.g. g-set
                     # elements beyond the bitmask, crashed queue
-                    # dequeues): the host model handles it
+                    # dequeues, values outside int32): the host model
+                    # handles it
                     if algo == "tpu":
                         raise
             elif algo == "tpu":
                 return {"valid?": UNKNOWN,
                         "error": f"model {self.model!r} has no device form"}
         if a is None:
-            a = analysis_host(self.model, hist)
+            a = analysis_host(self.model, hist,
+                              budget_s=self.opts.get("budget_s"))
         a = _truncate(a)
         try:
             from .explain import write_failure_svg

@@ -53,7 +53,8 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ..history import (F_CAS, F_READ, F_WRITE, KIND_OK, NIL, OpArray,
+from ..history import (DeviceEncodingError, F_CAS, F_READ, F_WRITE,
+                       KIND_OK, NIL, OpArray,
                        PENDING_RET, History, default_register_codec,
                        encode_ops, history as as_history)
 
@@ -162,7 +163,7 @@ def gset_codec(o: dict) -> tuple[int, int, int]:
     if f == "add":
         v = int(v)
         if not 0 <= v < GSET_MAX_ELEMENTS:
-            raise ValueError(
+            raise DeviceEncodingError(
                 f"g-set element {v} outside [0, {GSET_MAX_ELEMENTS})"
                 " — use the host model")
         return 1, v, NIL
@@ -173,7 +174,7 @@ def gset_codec(o: dict) -> tuple[int, int, int]:
         for x in v:
             x = int(x)
             if not 0 <= x < GSET_MAX_ELEMENTS:
-                raise ValueError(
+                raise DeviceEncodingError(
                     f"g-set element {x} outside "
                     f"[0, {GSET_MAX_ELEMENTS}) — use the host model")
             mask |= 1 << x
@@ -212,12 +213,13 @@ def _uqueue_step(state, f, a, b):
     return legal, new
 
 
-def _uqueue_validate(ops: OpArray) -> None:
+def _uqueue_validate(ops: OpArray, model) -> None:
     """A sound upper bound on any reachable per-value multiplicity:
-    enqueues invoked so far minus ok dequeues returned so far, maxed
-    over the event stream. If it can exceed the 4-bit digit cap the
-    device multiset would silently saturate and report a false
-    invalid — raise so the checker falls back to the host model."""
+    initial copies plus enqueues invoked so far, minus ok dequeues
+    returned so far, maxed over the event stream. If it can exceed
+    the 4-bit digit cap the device multiset would silently saturate
+    (carrying into the next value's digit) — raise so the checker
+    falls back to the host model."""
     events: list[tuple[int, int, int]] = []
     for r in range(len(ops)):
         v = int(ops.a[r])
@@ -227,11 +229,17 @@ def _uqueue_validate(ops: OpArray) -> None:
             events.append((int(ops.ret[r]), 1, v))
     events.sort()
     outstanding = [0] * UQ_VALUES
+    for (v, _i) in getattr(model, "pending", ()):
+        outstanding[int(v)] += 1
+        if outstanding[int(v)] > UQ_COUNT_MAX:
+            raise DeviceEncodingError(
+                f"initial queue state has more than {UQ_COUNT_MAX} "
+                f"copies of {v} — use the host model")
     for _, kind, v in events:
         if kind == 0:
             outstanding[v] += 1
             if outstanding[v] > UQ_COUNT_MAX:
-                raise ValueError(
+                raise DeviceEncodingError(
                     f"queue value {v} may have more than "
                     f"{UQ_COUNT_MAX} outstanding copies — the device "
                     "multiset digit would saturate; use the host model")
@@ -242,12 +250,12 @@ def _uqueue_validate(ops: OpArray) -> None:
 def uqueue_codec(o: dict) -> tuple[int, int, int]:
     f, v = o["f"], o["value"]
     if v is None:
-        raise ValueError(
+        raise DeviceEncodingError(
             "queue op with unknown value (crashed dequeue?) — the "
             "device multiset can't branch over it; use the host model")
     v = int(v)
     if not 0 <= v < UQ_VALUES:
-        raise ValueError(
+        raise DeviceEncodingError(
             f"queue value {v} outside [0, {UQ_VALUES}) — use the "
             "host model")
     if f == "enqueue":
@@ -272,7 +280,7 @@ class DeviceModel:
     codec: Callable
     droppable: frozenset
     state_range: Callable
-    validate: Callable | None = None  # OpArray -> None | raise ValueError
+    validate: Callable | None = None  # (OpArray, model) -> None | raise
 
     def __iter__(self):  # legacy tuple shape: (step, codec, droppable)
         return iter((self.step, self.codec, self.droppable))
@@ -881,9 +889,12 @@ def encode_ops_for_model(model, hist) -> OpArray:
     if name is None or name not in DEVICE_MODELS:
         raise ValueError(f"model {model!r} has no device form")
     dm = DEVICE_MODELS[name]
-    ops = encode_ops(as_history(hist), dm.codec, dm.droppable)
+    try:
+        ops = encode_ops(as_history(hist), dm.codec, dm.droppable)
+    except OverflowError as e:   # value outside int32
+        raise DeviceEncodingError(str(e)) from e
     if dm.validate is not None:
-        dm.validate(ops)
+        dm.validate(ops, model)
     return ops
 
 

@@ -89,22 +89,51 @@ class Context:
     free_threads: tuple
     workers: dict
 
+    # Direct construction instead of dataclasses.replace: these run in
+    # the interpreter's per-op hot path (>20k ops/s parity target,
+    # `generator.clj:66-70`), and replace() re-walks the signature.
+
     def with_time(self, t: int) -> "Context":
-        return dataclasses.replace(self, time=t)
+        c = Context(t, self.free_threads, self.workers)
+        # restrictions are time-independent: share the memo
+        try:
+            object.__setattr__(c, "_restrict_cache",
+                               self._restrict_cache)
+        except AttributeError:
+            pass
+        return c
 
     def busy(self, thread) -> "Context":
-        return dataclasses.replace(
-            self, free_threads=tuple(t for t in self.free_threads
-                                     if t != thread))
+        return Context(self.time,
+                       tuple(t for t in self.free_threads if t != thread),
+                       self.workers)
 
     def free(self, thread) -> "Context":
         if thread in self.free_threads:
             return self
-        return dataclasses.replace(
-            self, free_threads=self.free_threads + (thread,))
+        return Context(self.time, self.free_threads + (thread,),
+                       self.workers)
 
     def with_workers(self, workers: dict) -> "Context":
-        return dataclasses.replace(self, workers=workers)
+        return Context(self.time, self.free_threads, workers)
+
+    def restrict(self, key, pred) -> "Context":
+        """A view containing only threads satisfying pred. The
+        (free-threads, workers) filtering is memoized per pred on this
+        context (and shared through with_time, which changes neither):
+        thread-routing combinators re-restrict the same context many
+        times per op."""
+        try:
+            cache = self._restrict_cache
+        except AttributeError:
+            cache = {}
+            object.__setattr__(self, "_restrict_cache", cache)
+        got = cache.get(key)
+        if got is None:
+            got = (tuple(t for t in self.free_threads if pred(t)),
+                   {t: p for t, p in self.workers.items() if pred(t)})
+            cache[key] = got
+        return Context(self.time, got[0], got[1])
 
 
 def context(test: dict) -> Context:
@@ -281,16 +310,28 @@ def update(gen, test: dict, ctx: Context, event: dict):
     raise TypeError(f"not a generator: {gen!r}")
 
 
+def _fn_gen_arity(f: Callable) -> int:
+    """Required positional arity, memoized on the function object —
+    signature inspection per emitted op dominates the hot loop."""
+    n = getattr(f, "__gen_arity__", None)
+    if n is None:
+        try:
+            sig = inspect.signature(f)
+            n = len([p for p in sig.parameters.values()
+                     if p.default is inspect.Parameter.empty
+                     and p.kind in (p.POSITIONAL_ONLY,
+                                    p.POSITIONAL_OR_KEYWORD)])
+        except (TypeError, ValueError):
+            n = 0
+        try:
+            f.__gen_arity__ = n
+        except (AttributeError, TypeError):
+            pass
+    return n
+
+
 def _call_fn_gen(f: Callable, test: dict, ctx: Context):
-    try:
-        sig = inspect.signature(f)
-        n = len([p for p in sig.parameters.values()
-                 if p.default is inspect.Parameter.empty
-                 and p.kind in (p.POSITIONAL_ONLY,
-                                p.POSITIONAL_OR_KEYWORD)])
-    except (TypeError, ValueError):
-        n = 0
-    return f(test, ctx) if n >= 2 else f()
+    return f(test, ctx) if _fn_gen_arity(f) >= 2 else f()
 
 
 # ---------------------------------------------------------------------------
@@ -510,9 +551,9 @@ def on_update(f, gen):
 # ---------------------------------------------------------------------------
 
 def _restrict_ctx(pred: Callable, ctx: Context) -> Context:
-    return Context(ctx.time,
-                   tuple(t for t in ctx.free_threads if pred(t)),
-                   {t: p for t, p in ctx.workers.items() if pred(t)})
+    # the pred object itself is the key (identity equality for
+    # functions) — keeping a reference also rules out id() reuse
+    return ctx.restrict(pred, pred)
 
 
 @dataclasses.dataclass(frozen=True)

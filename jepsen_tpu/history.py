@@ -40,6 +40,13 @@ NEMESIS = "nemesis"
 NIL = -1
 
 
+class DeviceEncodingError(ValueError):
+    """The history (or model state) exceeds a device encoding's
+    capacity — checkers catch this and fall back to the host model.
+    Deliberately distinct from plain ValueError so configuration
+    errors (e.g. forcing an ineligible engine) still surface."""
+
+
 def op(type: str, f: Any, value: Any = None, process: Any = None,
        time: int | None = None, **extra: Any) -> dict:
     """Build an op map."""

@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
-from .history import F_CAS, F_READ, F_WRITE, NIL
+from .history import DeviceEncodingError, F_CAS, F_READ, F_WRITE, NIL
 
 
 class Inconsistent:
@@ -190,7 +190,7 @@ class GSet(Model):
         for v in self.members:
             v = int(v)
             if not 0 <= v < 31:
-                raise ValueError(
+                raise DeviceEncodingError(
                     f"g-set element {v} outside the device bitmask "
                     "[0, 31) — use the host model")
             state |= 1 << v
@@ -211,15 +211,20 @@ class UnorderedQueue(Model):
     device_model = "unordered-queue"
 
     def device_state(self) -> int:
-        state = 0
+        counts = [0] * 7
         for (v, _i) in self.pending:
             v = int(v)
             if not 0 <= v < 7:
-                raise ValueError(
+                raise DeviceEncodingError(
                     f"queue value {v} outside the device digit range "
                     "[0, 7) — use the host model")
-            state += 1 << (4 * v)
-        return state
+            counts[v] += 1
+            if counts[v] > 15:
+                raise DeviceEncodingError(
+                    f"more than 15 copies of {v} in the initial queue "
+                    "state would carry into the next digit — use the "
+                    "host model")
+        return sum(c << (4 * v) for v, c in enumerate(counts))
 
     @staticmethod
     def _add(pending: frozenset, v: Any) -> frozenset:

@@ -483,10 +483,14 @@ def counter_workload(opts) -> dict:
         "client": CounterClient(),
         "generator": gen.mix([add] * 100 + [r]),
         # the O(n) bounds checker (reference behavior) plus full
-        # linearizability against the device counter model
+        # linearizability against the device counter model; budgeted —
+        # under the kill nemesis, crashed adds accumulate and the
+        # search is genuinely exponential past the device slot cap
         "checker": checker.compose({
             "counter": checker.counter(),
-            "linear": linear.linearizable(models.counter()),
+            "linear": linear.linearizable(
+                models.counter(),
+                budget_s=opts.get("linear-budget-s", 60)),
         }),
     }
 

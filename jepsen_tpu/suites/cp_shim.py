@@ -14,6 +14,7 @@ via the control layer.
 from __future__ import annotations
 
 import json
+import random as _random
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -97,13 +98,12 @@ class CPState:
         return {"ok": True, "value": q.pop(0) if q else None}
 
     def op_queue_poll_value(self, req):
-        """Remove one instance of a specific value (an unordered
-        dequeue, for the queue-linear workload)."""
+        """Remove one arbitrary (non-FIFO) element — the unordered
+        dequeue for the queue-linear workload."""
         q = self.queues.get(req["name"]) or []
         if not q:
             return {"ok": True, "value": None}
-        import random
-        v = random.choice(q)
+        v = _random.choice(q)
         q.remove(v)
         return {"ok": True, "value": v}
 

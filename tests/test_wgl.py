@@ -533,3 +533,35 @@ def test_gset_out_of_range_initial_state_falls_back():
     r = linearizable(model).check({}, History(h), {})
     assert r["valid?"] is True
     assert r["analyzer"] == "host-jit-linear"
+
+
+def test_out_of_int32_values_fall_back_to_host():
+    """Values beyond int32 can't encode; the checker must fall back,
+    not crash with OverflowError."""
+    from jepsen_tpu.checker.linear import linearizable
+    h = [op("invoke", "add", 2**31, 0), op("ok", "add", 2**31, 0),
+         op("invoke", "read", None, 0), op("ok", "read", 2**31, 0)]
+    r = linearizable(m.counter()).check({}, History(h), {})
+    assert r["valid?"] is True
+    assert r["analyzer"] == "host-jit-linear"
+
+
+def test_uqueue_initial_multiplicity_cap():
+    from jepsen_tpu.checker.linear import linearizable
+    model = m.UnorderedQueue(frozenset((1, i) for i in range(16)))
+    h = [op("invoke", "dequeue", None, 0), op("ok", "dequeue", 1, 0)]
+    r = linearizable(model).check({}, History(h), {})
+    assert r["valid?"] is True
+    assert r["analyzer"] == "host-jit-linear"
+
+
+def test_forced_dense_engine_error_still_surfaces():
+    """engine='dense' on an ineligible history must raise, not be
+    silently downgraded to the host search."""
+    h = synth.register_history(50, concurrency=3, values=3,
+                               crash_rate=0.0, seed=2)
+    big = [dict(o) for o in h.ops]
+    big[0] = {**big[0], "value": 10**6}
+    big[1] = {**big[1], "value": 10**6}
+    with pytest.raises(ValueError, match="dense"):
+        analysis_tpu(m.cas_register(), History(big), engine="dense")
