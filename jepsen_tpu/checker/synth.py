@@ -273,7 +273,8 @@ def append_history(n_txns: int, concurrency: int = 10,
 
 def inject_append_cycles(hist: History, n_cycles: int = 1,
                          anomaly: str = "G1c",
-                         seed: int = 7) -> History:
+                         seed: int = 7,
+                         key_base: int = 10 ** 9) -> History:
     """Append `n_cycles` disjoint two-transaction anomaly cycles on fresh
     keys to a (valid) list-append history — each becomes one nontrivial
     SCC, exercising the batched device classification. anomaly: 'G1c'
@@ -281,7 +282,7 @@ def inject_append_cycles(hist: History, n_cycles: int = 1,
     rng = random.Random(seed)
     ops = [dict(o) for o in hist.ops]
     t = 1 + max((o.get("time", 0) for o in ops), default=0)
-    base = 10 ** 9  # key space far above the generator's
+    base = key_base  # key space far above the generator's
     p1, p2 = 10 ** 6, 10 ** 6 + 1
     for c in range(n_cycles):
         kx, ky = base + 2 * c, base + 2 * c + 1

@@ -102,7 +102,7 @@ def mutex_codec(o: dict) -> tuple[int, int, int]:
         return 0, NIL, NIL
     if f == "release":
         return 1, NIL, NIL
-    raise ValueError(f"unknown mutex op f={f!r}")
+    raise DeviceEncodingError(f"unknown mutex op f={f!r}")
 
 
 # -- counter: f 0 = read(observed; b=1 iff constrained), 1 = add(delta) ------
@@ -126,7 +126,7 @@ def counter_codec(o: dict) -> tuple[int, int, int]:
         return 0, int(v), 1
     if f == "add":
         return 1, int(v), NIL
-    raise ValueError(f"unknown counter op f={f!r}")
+    raise DeviceEncodingError(f"unknown counter op f={f!r}")
 
 
 def _counter_range(init, f, a, b):
@@ -179,7 +179,7 @@ def gset_codec(o: dict) -> tuple[int, int, int]:
                     f"[0, {GSET_MAX_ELEMENTS}) — use the host model")
             mask |= 1 << x
         return 0, mask, NIL
-    raise ValueError(f"unknown g-set op f={f!r}")
+    raise DeviceEncodingError(f"unknown g-set op f={f!r}")
 
 
 def _gset_range(init, f, a, b):
@@ -195,8 +195,8 @@ def _gset_range(init, f, a, b):
 # -- unordered queue: f 0 = dequeue(v), 1 = enqueue(v) -----------------------
 # state: 4-bit per-value multiplicities, values in [0, 7)
 
-UQ_VALUES = 7
-UQ_COUNT_MAX = 15
+from ..history import UQ_COUNT_MAX, UQ_VALUES  # noqa: E402 (shared
+# with models.UnorderedQueue.device_state — one copy of the layout)
 
 
 def _uqueue_step(state, f, a, b):
@@ -230,8 +230,13 @@ def _uqueue_validate(ops: OpArray, model) -> None:
     events.sort()
     outstanding = [0] * UQ_VALUES
     for (v, _i) in getattr(model, "pending", ()):
-        outstanding[int(v)] += 1
-        if outstanding[int(v)] > UQ_COUNT_MAX:
+        v = int(v)
+        if not 0 <= v < UQ_VALUES:
+            raise DeviceEncodingError(
+                f"initial queue value {v} outside [0, {UQ_VALUES}) — "
+                "use the host model")
+        outstanding[v] += 1
+        if outstanding[v] > UQ_COUNT_MAX:
             raise DeviceEncodingError(
                 f"initial queue state has more than {UQ_COUNT_MAX} "
                 f"copies of {v} — use the host model")
@@ -262,7 +267,7 @@ def uqueue_codec(o: dict) -> tuple[int, int, int]:
         return 1, v, NIL
     if f == "dequeue":
         return 0, v, NIL
-    raise ValueError(f"unknown queue op f={f!r}")
+    raise DeviceEncodingError(f"unknown queue op f={f!r}")
 
 
 @dataclasses.dataclass(frozen=True)

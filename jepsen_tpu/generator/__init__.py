@@ -734,7 +734,9 @@ class Reserve(Gen):
     def op(self, test, ctx):
         best = None
         for i, threads in enumerate(self._range_sets(ctx)):
-            sub = _restrict_ctx(lambda t, s=threads: t in s, ctx)
+            # the frozenset itself is the memo key: a fresh lambda per
+            # call would defeat (and unboundedly grow) the cache
+            sub = ctx.restrict(threads, lambda t, s=threads: t in s)
             res = op(self.gens[i], test, sub)
             if res is not None:
                 best = _soonest(best, {"op": res[0], "gen": res[1],

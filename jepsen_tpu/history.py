@@ -40,6 +40,11 @@ NEMESIS = "nemesis"
 NIL = -1
 
 
+# device unordered-queue multiset layout: 4-bit per-value counts
+UQ_VALUES = 7
+UQ_COUNT_MAX = 15
+
+
 class DeviceEncodingError(ValueError):
     """The history (or model state) exceeds a device encoding's
     capacity — checkers catch this and fall back to the host model.
@@ -297,7 +302,7 @@ def default_register_codec(o: dict) -> tuple[int, int, int]:
     if f in ("cas", F_CAS):
         old, new = v
         return F_CAS, int(old), int(new)
-    raise ValueError(f"unknown register op f={f!r}")
+    raise DeviceEncodingError(f"unknown register op f={f!r}")
 
 
 def encode_ops(h: History,

@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
-from .history import DeviceEncodingError, F_CAS, F_READ, F_WRITE, NIL
+from .history import DeviceEncodingError, UQ_COUNT_MAX, UQ_VALUES, F_CAS, F_READ, F_WRITE, NIL
 
 
 class Inconsistent:
@@ -211,19 +211,19 @@ class UnorderedQueue(Model):
     device_model = "unordered-queue"
 
     def device_state(self) -> int:
-        counts = [0] * 7
+        counts = [0] * UQ_VALUES
         for (v, _i) in self.pending:
             v = int(v)
-            if not 0 <= v < 7:
+            if not 0 <= v < UQ_VALUES:
                 raise DeviceEncodingError(
                     f"queue value {v} outside the device digit range "
-                    "[0, 7) — use the host model")
+                    f"[0, {UQ_VALUES}) — use the host model")
             counts[v] += 1
-            if counts[v] > 15:
+            if counts[v] > UQ_COUNT_MAX:
                 raise DeviceEncodingError(
-                    f"more than 15 copies of {v} in the initial queue "
-                    "state would carry into the next digit — use the "
-                    "host model")
+                    f"more than {UQ_COUNT_MAX} copies of {v} in the "
+                    "initial queue state would carry into the next "
+                    "digit — use the host model")
         return sum(c << (4 * v) for v, c in enumerate(counts))
 
     @staticmethod

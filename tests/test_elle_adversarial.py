@@ -1,0 +1,202 @@
+"""Adversarial golden corpus for the Elle cycle-classification stack.
+
+The composite classifier rests on three mechanisms (kernels.py): the
+dense distinct-rw-sources G2 test, the budgeted simple-path host
+probes, and the oversized-SCC path. Each case here is built to fool
+one of them; the expected labels follow Elle's anomaly semantics
+(`tests/cycle/wr.clj:31-45`: G-single = a cycle with exactly one
+anti-dependency edge, G2-item = a *simple* cycle with two or more).
+"""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker.elle import kernels
+from jepsen_tpu.checker import synth
+from jepsen_tpu.checker.elle import list_append
+
+
+def analyze(n, edge_list, **kw):
+    edges = {}
+    for i, j, t in edge_list:
+        edges.setdefault((i, j), set()).add(t)
+    return kernels.analyze_edges(n, edges, **kw)
+
+
+def flags(out):
+    return {k: out[k] for k in ("G0", "G1c", "G-single", "G2-item")}
+
+
+# -- figure-eights: the distinct-rw-sources test's blind spot ---------------
+
+def test_figure_eight_is_g_single_not_g2():
+    """Two one-rw cycles sharing a node have two rw edges with distinct
+    sources, but no SIMPLE cycle contains both — G-single, not G2."""
+    out = analyze(3, [(0, 1, "rw"), (1, 0, "ww"),
+                      (1, 2, "rw"), (2, 1, "ww")])
+    assert flags(out) == {"G0": False, "G1c": False,
+                          "G-single": True, "G2-item": False}
+
+
+def test_figure_eight_with_wr_return_paths():
+    out = analyze(4, [(0, 1, "rw"), (1, 2, "wr"), (2, 0, "ww"),
+                      (2, 3, "rw"), (3, 2, "ww")])
+    assert out["G-single"] is True
+    assert out["G2-item"] is False
+
+
+def test_three_petal_flower_shared_center():
+    """Many G-single cycles through one shared center node."""
+    edges = []
+    for k in (1, 2, 3):
+        edges.append((0, k, "rw"))
+        edges.append((k, 0, "ww"))
+    out = analyze(4, edges)
+    assert flags(out) == {"G0": False, "G1c": False,
+                          "G-single": True, "G2-item": False}
+
+
+def test_chained_figure_eights():
+    """A ladder of single-rw cycles, each sharing a node with the
+    next: still no simple two-rw cycle."""
+    edges = []
+    for k in range(5):
+        a, b = k, k + 1
+        edges.append((a, b, "rw"))
+        edges.append((b, a, "ww"))
+    out = analyze(6, edges)
+    assert out["G-single"] is True
+    assert out["G2-item"] is False
+
+
+# -- true G2 cycles ----------------------------------------------------------
+
+def test_two_rw_simple_cycle_is_g2():
+    out = analyze(4, [(0, 1, "rw"), (1, 2, "ww"),
+                      (2, 3, "rw"), (3, 0, "ww")])
+    assert flags(out) == {"G0": False, "G1c": False,
+                          "G-single": False, "G2-item": True}
+
+
+def test_g2_cycle_with_attached_g_single_petal():
+    """A genuine two-rw simple cycle sharing a node with a one-rw
+    cycle: both labels must appear."""
+    out = analyze(5, [(0, 1, "rw"), (1, 2, "ww"),
+                      (2, 3, "rw"), (3, 0, "ww"),
+                      (0, 4, "rw"), (4, 0, "wr")])
+    assert out["G-single"] is True
+    assert out["G2-item"] is True
+
+
+def test_adjacent_double_rw_cycle():
+    """rw edges may be adjacent in a G2 cycle (write skew shape)."""
+    out = analyze(2, [(0, 1, "rw"), (1, 0, "rw")])
+    assert out["G-single"] is False
+    assert out["G2-item"] is True
+
+
+# -- G0 / G1c hierarchy ------------------------------------------------------
+
+def test_ww_cycle_is_g0():
+    out = analyze(2, [(0, 1, "ww"), (1, 0, "ww")])
+    assert out["G0"] is True and out["G1c"] is True
+    assert out["G-single"] is False and out["G2-item"] is False
+
+
+def test_wr_cycle_is_g1c_not_g0():
+    out = analyze(2, [(0, 1, "wr"), (1, 0, "ww")])
+    assert out["G0"] is False and out["G1c"] is True
+
+
+def test_g1c_with_unrelated_g_single():
+    out = analyze(5, [(0, 1, "wr"), (1, 0, "ww"),
+                      (2, 3, "rw"), (3, 2, "ww")])
+    assert out["G0"] is False and out["G1c"] is True
+    assert out["G-single"] is True and out["G2-item"] is False
+
+
+# -- oversized-SCC path (force it with a tiny max_dense) --------------------
+
+def _ring(n, rw_at=()):
+    return [(k, (k + 1) % n, "rw" if k in rw_at else "ww")
+            for k in range(n)]
+
+
+def test_oversized_ww_ring():
+    out = analyze(64, _ring(64), max_dense=8)
+    assert out["oversized-sccs"] == 1
+    assert out["G0"] is True
+    assert out["G-single"] is False and out["G2-item"] is False
+
+
+def test_oversized_one_rw_ring_is_g_single():
+    out = analyze(64, _ring(64, rw_at={10}), max_dense=8)
+    assert out["oversized-sccs"] == 1
+    assert flags(out) == {"G0": False, "G1c": False,
+                          "G-single": True, "G2-item": False}
+
+
+def test_oversized_two_rw_ring_is_g2():
+    out = analyze(64, _ring(64, rw_at={10, 40}), max_dense=8)
+    assert out["oversized-sccs"] == 1
+    assert out["G-single"] is False
+    assert out["G2-item"] is True
+
+
+def test_oversized_figure_eight_stays_g_single():
+    """Two 32-node one-rw rings sharing node 0, classified through the
+    oversized path: the probes must not mislabel it G2."""
+    edges = []
+    for k in range(32):
+        edges.append((k, (k + 1) % 32, "rw" if k == 5 else "ww"))
+    # second ring on nodes {0, 32..62}
+    ring2 = [0] + list(range(32, 63))
+    for ix, v in enumerate(ring2):
+        w = ring2[(ix + 1) % len(ring2)]
+        edges.append((v, w, "rw" if ix == 7 else "ww"))
+    out = analyze(63, edges, max_dense=8)
+    assert out["oversized-sccs"] == 1
+    assert out["G-single"] is True
+    assert out["G2-item"] is False
+
+
+# -- dense kernel vs oversized probes must agree ----------------------------
+
+@pytest.mark.parametrize("seed", range(12))
+def test_dense_and_probe_paths_agree_on_random_graphs(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(4, 14))
+    m = int(rng.integers(n, 3 * n))
+    edge_list = []
+    for _ in range(m):
+        i, j = rng.integers(0, n, 2)
+        if i == j:
+            continue
+        t = ("ww", "wr", "rw")[int(rng.integers(0, 3))]
+        edge_list.append((int(i), int(j), t))
+    dense = flags(analyze(n, edge_list, max_dense=4096))
+    probed = flags(analyze(n, edge_list, max_dense=2))
+    assert dense == probed, (edge_list, dense, probed)
+
+
+# -- history level -----------------------------------------------------------
+
+def test_injected_g_single_labels_exactly():
+    h = synth.append_history(3000, seed=7)
+    bad = synth.inject_append_cycles(h, 8, "G-single")
+    r = list_append.check(bad)
+    assert r["valid?"] is False
+    assert "G-single" in r["anomaly-types"]
+    assert "G2-item" not in r["anomaly-types"]
+    assert "G1c" not in r["anomaly-types"]
+
+
+def test_injected_mixed_anomalies():
+    h = synth.append_history(3000, seed=8)
+    bad = synth.inject_append_cycles(h, 4, "G1c")
+    bad = synth.inject_append_cycles(bad, 4, "G-single", seed=11,
+                                     key_base=2 * 10 ** 9)
+    r = list_append.check(bad)
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"]
+    assert "G-single" in r["anomaly-types"]
