@@ -13,7 +13,8 @@ import urllib.request
 
 SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
           "cockroach", "disque", "rabbitmq", "galera", "percona",
-          "stolon", "postgres_rds", "raftis", "mongodb", "aerospike")
+          "stolon", "postgres_rds", "raftis", "mongodb", "aerospike",
+          "mongodb_smartos")
 
 
 def suite(name: str):

@@ -80,7 +80,36 @@ class FakeMongo(socketserver.ThreadingTCPServer):
 
     @staticmethod
     def _matches(doc, query) -> bool:
-        return all(doc.get(k) == v for k, v in query.items())
+        for k, v in query.items():
+            cur = doc.get(k)
+            if isinstance(v, dict) and "$ne" in v:
+                t = v["$ne"]
+                if (t in cur) if isinstance(cur, list) else (cur == t):
+                    return False
+                continue
+            if isinstance(v, dict) and "$size" in v:
+                if len(cur or []) != v["$size"]:
+                    return False
+                continue
+            if isinstance(cur, list) and not isinstance(v, list):
+                if v not in cur:
+                    return False
+                continue
+            if cur != v:
+                return False
+        return True
+
+    @staticmethod
+    def _apply_update(doc, u) -> None:
+        doc.update(u.get("$set", {}))
+        for k, d in u.get("$inc", {}).items():
+            doc[k] = doc.get(k, 0) + d
+        for k, v in u.get("$push", {}).items():
+            doc.setdefault(k, []).append(v)
+        for k, v in u.get("$pull", {}).items():
+            if v in doc.get(k, []):
+                doc[k] = [x for x in doc[k] if x != v]
+        # $currentDate ignored (no clock semantics in the fake)
 
     def dispatch(self, cmd: dict) -> dict:
         with self.lock:
@@ -110,7 +139,7 @@ class FakeMongo(socketserver.ThreadingTCPServer):
                 hit = [d for d in coll
                        if self._matches(d, cmd.get("query") or {})]
                 if hit:
-                    hit[0].update(cmd["update"].get("$set", {}))
+                    self._apply_update(hit[0], cmd["update"])
                     return {"ok": 1, "value": hit[0],
                             "lastErrorObject":
                                 {"updatedExisting": True, "n": 1}}
@@ -124,11 +153,12 @@ class FakeMongo(socketserver.ThreadingTCPServer):
                     hit = [d for d in coll if self._matches(d, u["q"])]
                     if hit:
                         for d in hit:
-                            d.update(u["u"].get("$set", {}))
+                            self._apply_update(d, u["u"])
                             n += 1
                     elif u.get("upsert"):
-                        doc = dict(u["q"])
-                        doc.update(u["u"].get("$set", {}))
+                        doc = {k: v for k, v in u["q"].items()
+                               if not isinstance(v, dict)}
+                        self._apply_update(doc, u["u"])
                         coll.append(doc)
                         n += 1
                 return {"ok": 1, "n": n}
