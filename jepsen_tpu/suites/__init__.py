@@ -14,7 +14,8 @@ import urllib.request
 SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
           "cockroach", "disque", "rabbitmq", "galera", "percona",
           "stolon", "postgres_rds", "raftis", "mongodb", "aerospike",
-          "mongodb_smartos")
+          "mongodb_smartos", "logcabin", "robustirc",
+          "mysql_cluster", "rethinkdb")
 
 
 def suite(name: str):

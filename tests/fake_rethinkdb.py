@@ -1,0 +1,189 @@
+"""In-process fake RethinkDB speaking the V0_4/JSON ReQL subset in
+`jepsen_tpu/suites/reql_proto.py`: db/table create, get, get_field
+with default, insert with conflict=update, and update with a
+branch-on-eq row function (the cas). One consistent store."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from jepsen_tpu.suites import reql_proto as r
+
+
+class FakeRethinkDB:
+    def __init__(self):
+        self.tables: dict[tuple, dict] = {}   # (db, tbl) -> {id: doc}
+        self.lock = threading.Lock()
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(32)
+        self.port = self.srv.getsockname()[1]
+        self.running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def stop(self):
+        self.running = False
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+    def _accept(self):
+        while self.running:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _read_exact(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        try:
+            magic, = struct.unpack("<I", self._read_exact(conn, 4))
+            klen, = struct.unpack("<I", self._read_exact(conn, 4))
+            if klen:
+                self._read_exact(conn, klen)
+            self._read_exact(conn, 4)  # protocol magic
+            conn.sendall(b"SUCCESS\x00")
+            while True:
+                token, = struct.unpack("<q", self._read_exact(conn, 8))
+                qlen, = struct.unpack("<I", self._read_exact(conn, 4))
+                qtype, term, _opts = json.loads(
+                    self._read_exact(conn, qlen))
+                try:
+                    with self.lock:
+                        out = self._eval(term, None)
+                    resp = {"t": r.R_SUCCESS_ATOM, "r": [out]}
+                except _Abort as e:
+                    resp = {"t": r.R_RUNTIME_ERROR, "r": [str(e)]}
+                body = json.dumps(resp).encode()
+                conn.sendall(struct.pack("<q", token)
+                             + struct.pack("<I", len(body)) + body)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- term evaluation -----------------------------------------------------
+
+    def _eval(self, term, row):
+        if not isinstance(term, list):
+            return term
+        tid, args = term[0], term[1] if len(term) > 1 else []
+        opts = term[2] if len(term) > 2 else {}
+        if tid == r.T_DB:
+            return ("db", args[0])
+        if tid == r.T_DB_CREATE:
+            return {"dbs_created": 1}
+        if tid == r.T_TABLE_CREATE:
+            dbref = self._eval(args[0], row)
+            key = (dbref[1], args[1])
+            if key in self.tables:
+                raise _Abort(f"Table `{args[1]}` already exists")
+            self.tables[key] = {}
+            return {"tables_created": 1}
+        if tid == r.T_TABLE:
+            dbref = self._eval(args[0], row)
+            return ("table", self.tables.setdefault(
+                (dbref[1], args[1]), {}))
+        if tid == r.T_WAIT:
+            return {"ready": 1}
+        if tid == r.T_GET:
+            tbl = self._eval(args[0], row)[1]
+            return ("doc", tbl, self._eval(args[1], row))
+        if tid == r.T_GET_FIELD:
+            target = self._eval(args[0], row)
+            doc = self._deref(target)
+            field = self._eval(args[1], row)
+            if doc is None or field not in doc:
+                raise _Abort(f"No attribute `{field}`")
+            return doc[field]
+        if tid == r.T_DEFAULT:
+            try:
+                return self._eval(args[0], row)
+            except _Abort:
+                return self._eval(args[1], row)
+        if tid == r.T_INSERT:
+            tbl = self._eval(args[0], row)[1]
+            doc = dict(args[1])
+            key = doc["id"]
+            if key in tbl and opts.get("conflict") != "update":
+                return {"errors": 1, "inserted": 0,
+                        "first_error": "Duplicate primary key"}
+            if key in tbl:
+                tbl[key].update(doc)
+                return {"errors": 0, "replaced": 1, "inserted": 0}
+            tbl[key] = doc
+            return {"errors": 0, "inserted": 1}
+        if tid == r.T_UPDATE:
+            target = self._eval(args[0], row)
+            if isinstance(target, tuple) and target[0] == "table":
+                # table-wide update (e.g. the rethinkdb.table_config
+                # write-acks reconfiguration): apply to every doc
+                n = 0
+                for doc in target[1].values():
+                    patch = self._apply_func(args[1], doc)
+                    doc.update(patch)
+                    n += 1
+                return {"errors": 0, "replaced": n}
+            doc = self._deref(target)
+            if doc is None:
+                return {"errors": 0, "skipped": 1, "replaced": 0}
+            func = args[1]
+            try:
+                patch = self._apply_func(func, doc)
+            except _Abort as e:
+                return {"errors": 1, "replaced": 0,
+                        "first_error": str(e)}
+            changed = any(doc.get(k) != v for k, v in patch.items())
+            doc.update(patch)
+            return {"errors": 0,
+                    "replaced": 1 if changed else 0,
+                    "unchanged": 0 if changed else 1}
+        if tid == r.T_EQ:
+            return self._eval(args[0], row) == self._eval(args[1], row)
+        if tid == r.T_BRANCH:
+            if self._eval(args[0], row):
+                return self._eval(args[1], row)
+            return self._eval(args[2], row)
+        if tid == r.T_ERROR:
+            raise _Abort(self._eval(args[0], row))
+        if tid == r.T_VAR:
+            return row
+        raise _Abort(f"unsupported term {tid}")
+
+    @staticmethod
+    def _deref(target):
+        if isinstance(target, tuple) and target[0] == "doc":
+            return target[1].get(target[2])
+        return target
+
+    def _apply_func(self, func, doc):
+        """[FUNC, [[MAKE_ARRAY,[1]], body]] applied to doc."""
+        if isinstance(func, dict):
+            return func
+        body = func[1][1]
+        out = self._eval(body, doc)
+        if not isinstance(out, dict):
+            raise _Abort("update function must return an object")
+        return out
+
+
+class _Abort(Exception):
+    pass

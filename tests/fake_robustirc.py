@@ -1,0 +1,71 @@
+"""In-process fake of the RobustIRC HTTP bridge: session creation,
+message post (NICK/USER/JOIN/TOPIC), and the message stream read —
+enough for the suite's set workload. Replies over plain HTTP (the
+suite's irc-url-fn points here)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeRobustIRC:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sessions: dict[str, dict] = {}
+        self.messages: list[dict] = []   # network-wide ordered log
+        self.next_id = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, obj, raw=None):
+                body = raw if raw is not None \
+                    else json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                with outer.lock:
+                    if self.path.endswith("/session"):
+                        outer.next_id += 1
+                        sid = f"s{outer.next_id}"
+                        outer.sessions[sid] = {"auth": f"a{sid}"}
+                        self._reply({"Sessionid": sid,
+                                     "Sessionauth": f"a{sid}"})
+                        return
+                    sid = self.path.split("/")[3]
+                    if sid not in outer.sessions:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    # the real network echoes messages with a sender
+                    # prefix (":nick!user@host TOPIC #chan :v"), which
+                    # is why clients parse the verb at position 1
+                    outer.messages.append(
+                        {"Data": f":{sid}!j@fake "
+                                 f"{req.get('Data', '')}",
+                         "Id": {"Id": len(outer.messages)}})
+                    self._reply({})
+
+            def do_GET(self):  # noqa: N802
+                with outer.lock:
+                    # concatenated JSON documents, like the real stream
+                    body = "\n".join(
+                        json.dumps(m) for m in outer.messages).encode()
+                self._reply(None, raw=body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()

@@ -24,7 +24,8 @@ class SQLError(Exception):
 
 
 _CREATE = re.compile(
-    r"create table (?:if not exists )?(\w+)\s*\((.*)\)\s*$",
+    r"create table (?:if not exists )?(\w+)\s*\((.*)\)"
+    r"\s*(?:engine\s*=\s*\w+\s*)?$",
     re.I | re.S)
 _INSERT = re.compile(
     r"(insert|upsert) into (\w+)\s*\(([^)]*)\)\s*values\s*\((.*?)\)"
@@ -98,6 +99,8 @@ class Session:
             if m:
                 return self._update(m)
             if low.startswith("set "):
+                return 0, None
+            if low.startswith(("create database", "use ")):
                 return 0, None
             raise SQLError(1064, f"unsupported statement: {sql!r}")
 

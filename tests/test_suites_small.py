@@ -1,0 +1,294 @@
+"""Small-suite sweep tests: logcabin, robustirc, mysql-cluster,
+rethinkdb — DB command generation, client semantics against fakes, and
+hermetic end-to-end runs."""
+
+import json
+import re
+
+import jepsen_tpu.db
+import jepsen_tpu.os_
+from fake_mysql import FakeMySQLServer
+from fake_rethinkdb import FakeRethinkDB
+from fake_robustirc import FakeRobustIRC
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.independent import ktuple
+from jepsen_tpu.suites import (logcabin, mysql_cluster, rethinkdb,
+                               robustirc, suite)
+from jepsen_tpu.suites.mysql_proto import Conn as MyConn
+from jepsen_tpu.suites.reql_proto import Conn as ReqlConn
+
+
+def test_suite_registry():
+    assert suite("logcabin") is logcabin
+    assert suite("robustirc") is robustirc
+    assert suite("mysql-cluster") is mysql_cluster
+    assert suite("rethinkdb") is rethinkdb
+
+
+def _with_n1(remote, fn):
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            return fn()
+
+
+# -- logcabin ----------------------------------------------------------------
+
+def test_logcabin_db_commands():
+    log = []
+    remote = dummy.remote(log=log)
+    test = {"nodes": ["n1", "n2", "n3"]}
+    _with_n1(remote, lambda: (logcabin.db().setup(test, "n1"),
+                              logcabin.db().teardown(test, "n1")))
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "git clone --depth 1" in cmds
+    assert "scons" in cmds
+    assert "--bootstrap" in cmds            # first node bootstraps
+    assert "/root/Reconfigure" in cmds and "set" in cmds
+    stdins = " ".join(a.get("in", "") for _h, _c, a in log
+                      if isinstance(a.get("in"), str))
+    assert "serverId = 1" in stdins
+
+
+class _LogCabinSim:
+    """A register behind scripted TreeOps command responses."""
+
+    def __init__(self):
+        self.value = "null"
+
+    def __call__(self, context, action):
+        cmd = action.get("cmd", "")
+        stdin = action.get("in", "")
+        m = re.search(r"-p /jepsen:(\S+) ", cmd)
+        if m:  # cas
+            if m.group(1) != self.value:
+                return {"exit": 1, "err": (
+                    f"Exiting due to LogCabin::Client::Exception: "
+                    f"Path '/jepsen' has value '{self.value}', not "
+                    f"'{m.group(1)}' as required")}
+            self.value = stdin
+            return {"exit": 0, "out": ""}
+        if " write /jepsen" in cmd:
+            self.value = stdin
+            return {"exit": 0, "out": ""}
+        if " read /jepsen" in cmd:
+            return {"exit": 0, "out": self.value}
+        return {"exit": 0, "out": ""}
+
+
+def test_logcabin_hermetic_run(tmp_path):
+    sim = _LogCabinSim()
+    remote = dummy.remote(responses={r"TreeOps": sim})
+    t = logcabin.logcabin_test({
+        "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+        "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+        "faults": ["none"]})
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["remote"] = remote
+    t["store-dir"] = str(tmp_path / "store")
+    done = core.run(t)
+    assert done["results"]["valid?"] is True, done["results"]
+    oks = sum(1 for o in done["history"] if o.get("type") == "ok")
+    assert oks > 10
+
+
+def test_logcabin_cas_mismatch_is_fail():
+    sim = _LogCabinSim()
+    sim.value = "3"
+    remote = dummy.remote(responses={r"TreeOps": sim})
+    test = {"nodes": ["n1"],
+            "sessions": {"n1": remote.connect({"host": "n1"})}}
+    c = logcabin.CASClient().open(test, "n1")
+    r = c.invoke(test, {"type": "invoke", "f": "cas", "value": (4, 5),
+                        "process": 0})
+    assert r["type"] == "fail" and r["error"] == "cas-mismatch"
+    r = c.invoke(test, {"type": "invoke", "f": "cas", "value": (3, 5),
+                        "process": 0})
+    assert r["type"] == "ok"
+    r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                        "process": 0})
+    assert r["type"] == "ok" and r["value"] == 5
+
+
+# -- robustirc ---------------------------------------------------------------
+
+def test_robustirc_session_and_topics():
+    f = FakeRobustIRC()
+    try:
+        t = {"irc-url-fn": lambda n: f"http://127.0.0.1:{f.port}"}
+        c = robustirc.SetClient().open(t, "n1")
+        for v in (1, 2, 3):
+            r = c.invoke(t, {"type": "invoke", "f": "add", "value": v,
+                             "process": 0})
+            assert r["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "read", "value": None,
+                         "process": 0})
+        assert r["type"] == "ok" and r["value"] == [1, 2, 3]
+    finally:
+        f.stop()
+
+
+def test_robustirc_hermetic_run(tmp_path):
+    f = FakeRobustIRC()
+    try:
+        t = robustirc.robustirc_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"]})
+        t["db"] = jepsen_tpu.db.noop
+        t["os"] = jepsen_tpu.os_.noop
+        t["irc-url-fn"] = lambda n: f"http://127.0.0.1:{f.port}"
+        t["store-dir"] = str(tmp_path / "store")
+        done = core.run(t)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+def test_robustirc_db_commands():
+    log = []
+    remote = dummy.remote(log=log, responses={r"dpkg-query": "ii"})
+    test = {"nodes": ["n1", "n2"]}
+    _with_n1(remote, lambda: robustirc.db().setup(test, "n1"))
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "go get -u github.com/robustirc/robustirc" in cmds
+    assert "-singlenode" in cmds            # n1 bootstraps the network
+    assert "start-stop-daemon" in cmds
+
+
+# -- mysql-cluster -----------------------------------------------------------
+
+def test_mysql_cluster_config_generation():
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"]}
+    conf = mysql_cluster.nodes_conf(test)
+    assert "[ndb_mgmd]\nNodeId=1\nhostname=n1" in conf
+    assert "NodeId=14" in conf              # ndbd ids 11+, 4 nodes max
+    assert "NodeId=15\nhostname" not in conf.split("[mysqld]")[0]
+    assert "[mysqld]\nNodeId=21\nhostname=n1" in conf
+    assert len(re.findall(r"\[ndbd\]", conf)) == 4
+
+
+def test_mysql_cluster_db_commands():
+    log = []
+    remote = dummy.remote(log=log)
+    test = {"nodes": ["n1", "n2", "n3"],
+            "deb-url": "file:///tmp/mysql-cluster.deb"}
+    _with_n1(remote, lambda: (mysql_cluster.db().setup(test, "n1"),
+                              mysql_cluster.db().teardown(test, "n1")))
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "dpkg -i --force-confask --force-confnew" in cmds
+    assert "ndb_mgmd --ndb-nodeid=1" in cmds
+    assert "ndbd --ndb-nodeid=11" in cmds
+    assert "mysqld_safe --defaults-file=/etc/my.cnf" in cmds
+
+
+def test_mysql_cluster_hermetic_run(tmp_path):
+    f = FakeMySQLServer()
+    try:
+        t = mysql_cluster.mysql_cluster_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"]})
+        t["db"] = jepsen_tpu.db.noop
+        t["os"] = jepsen_tpu.os_.noop
+        t["sql-conn-fn"] = lambda n: MyConn("127.0.0.1", f.port)
+        t["store-dir"] = str(tmp_path / "store")
+        done = core.run(t)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+# -- rethinkdb ---------------------------------------------------------------
+
+def test_reql_roundtrip():
+    from jepsen_tpu.suites import reql_proto as r
+    f = FakeRethinkDB()
+    try:
+        c = ReqlConn("127.0.0.1", f.port)
+        c.run(r.db_create("jepsen"))
+        c.run(r.table_create("jepsen", "cas"))
+        res = c.run(r.insert(r.table("jepsen", "cas"),
+                             {"id": 0, "val": 3}, conflict="update"))
+        assert res["errors"] == 0
+        v = c.run(r.default(
+            r.get_field(r.get(r.table("jepsen", "cas"), 0), "val"),
+            None))
+        assert v == 3
+        # cas via branch-on-eq update
+        res = c.run(r.update(
+            r.get(r.table("jepsen", "cas"), 0),
+            r.func(r.branch(
+                r.eq(r.get_field(r.var(1), "val"), 3),
+                {"val": 4}, r.error("abort")))))
+        assert res["replaced"] == 1
+        res = c.run(r.update(
+            r.get(r.table("jepsen", "cas"), 0),
+            r.func(r.branch(
+                r.eq(r.get_field(r.var(1), "val"), 9),
+                {"val": 5}, r.error("abort")))))
+        assert res["errors"] == 1
+        c.close()
+    finally:
+        f.stop()
+
+
+def test_rethinkdb_client_semantics():
+    f = FakeRethinkDB()
+    try:
+        t = {"reql-conn-fn": lambda n: ReqlConn("127.0.0.1", f.port),
+             "nodes": ["n1"]}
+        c = rethinkdb.DocumentCASClient().open(t, "n1")
+        c.setup(t)
+        assert c.invoke(t, {"type": "invoke", "f": "write",
+                            "value": ktuple(0, 3),
+                            "process": 0})["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "cas",
+                         "value": ktuple(0, (9, 1)), "process": 0})
+        assert r["type"] == "fail"
+        r = c.invoke(t, {"type": "invoke", "f": "cas",
+                         "value": ktuple(0, (3, 1)), "process": 0})
+        assert r["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "read",
+                         "value": ktuple(0, None), "process": 0})
+        assert r["type"] == "ok" and r["value"][1] == 1
+        c.close(t)
+    finally:
+        f.stop()
+
+
+def test_rethinkdb_hermetic_run(tmp_path):
+    f = FakeRethinkDB()
+    try:
+        t = rethinkdb.rethinkdb_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+            "ssh": {"dummy": True}, "rate": 200, "time-limit": 3,
+            "faults": ["none"]})
+        t["db"] = jepsen_tpu.db.noop
+        t["os"] = jepsen_tpu.os_.noop
+        t["reql-conn-fn"] = lambda n: ReqlConn("127.0.0.1", f.port)
+        t["store-dir"] = str(tmp_path / "store")
+        done = core.run(t)
+        assert done["results"]["valid?"] is True, done["results"]
+    finally:
+        f.stop()
+
+
+def test_rethinkdb_db_commands():
+    log = []
+    remote = dummy.remote(log=log)
+    test = {"nodes": ["n1", "n2", "n3"]}
+    db = rethinkdb.db(faketime=True)
+    _with_n1(remote, lambda: (db.setup(test, "n1"),
+                              db.teardown(test, "n1")))
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "apt-key add" in cmds
+    assert "service rethinkdb start" in cmds
+    assert "mv /usr/bin/rethinkdb /usr/bin/rethinkdb.no-faketime" \
+        in cmds
+    stdins = " ".join(a.get("in", "") for _h, _c, a in log
+                      if isinstance(a.get("in"), str))
+    assert "join=n1:29015" in stdins and "join=n3:29015" in stdins
+    assert "faketime -m -f" in stdins
