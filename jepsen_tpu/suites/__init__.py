@@ -15,7 +15,8 @@ SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
           "cockroach", "disque", "rabbitmq", "galera", "percona",
           "stolon", "postgres_rds", "raftis", "mongodb", "aerospike",
           "mongodb_smartos", "logcabin", "robustirc",
-          "mysql_cluster", "rethinkdb")
+          "mysql_cluster", "rethinkdb", "elasticsearch", "crate",
+          "ignite", "chronos")
 
 
 def suite(name: str):

@@ -172,8 +172,14 @@ class RegisterClient(jclient.Client):
     def open(self, test, node):
         c = RegisterClient()
         fn = test.get("sql-conn-fn")
-        c.conn = fn(node) if fn else Conn(node, SQL_PORT, user="root",
-                                          database="jepsen")
+        # connect without a schema: the database may not exist yet
+        # (ER_BAD_DB_ERROR in the handshake would wedge every client)
+        c.conn = fn(node) if fn else Conn(node, SQL_PORT, user="root")
+        try:
+            c.conn.query("create database if not exists jepsen")
+            c.conn.query("use jepsen")
+        except (MySQLError, OSError):
+            pass
         return c
 
     def close(self, test):

@@ -59,13 +59,29 @@ class DB(jdb.DB):
                     control.upload(f"{test['certs-dir']}/{f}",
                                    f"/tmp/{f}")
             else:
-                # the reference bundles pre-generated certs
-                # (`robustirc.clj:41-42`); generate equivalent
-                # self-signed ones on the node
-                control.exec_(
-                    "openssl", "req", "-x509", "-newkey", "rsa:2048",
-                    "-keyout", "/tmp/key.pem", "-out", "/tmp/cert.pem",
-                    "-days", "365", "-nodes", "-subj", "/CN=jepsen")
+                # the reference ships ONE pre-generated cert/key pair
+                # to every node (`robustirc.clj:41-42`): the primary
+                # generates, the control node relays the same pair to
+                # everyone (per-node certs would fail -tls_ca_file
+                # verification on join)
+                if node == test["nodes"][0]:
+                    control.exec_(
+                        "openssl", "req", "-x509", "-newkey",
+                        "rsa:2048", "-keyout", "/tmp/key.pem",
+                        "-out", "/tmp/cert.pem", "-days", "365",
+                        "-nodes", "-subj", "/CN=jepsen")
+                    import tempfile
+                    d = test.setdefault(
+                        "_robustirc-certs",
+                        tempfile.mkdtemp(prefix="robustirc-certs-"))
+                    for f in ("cert.pem", "key.pem"):
+                        control.download(f"/tmp/{f}", f"{d}/{f}")
+        core.synchronize(test)
+        with control.su():
+            if not test.get("certs-dir") and node != test["nodes"][0]:
+                d = test["_robustirc-certs"]
+                for f in ("cert.pem", "key.pem"):
+                    control.upload(f"{d}/{f}", f"/tmp/{f}")
             control.exec_("rm", "-rf", "/var/lib/robustirc")
             control.exec_("mkdir", "-p", "/var/lib/robustirc")
             common = (f"-listen={node}:{PORT}"
@@ -149,12 +165,41 @@ class Session:
             self.auth,
             {"Data": ircmessage, "ClientMessageId": msgid})
 
-    def messages(self) -> list:
-        out = self._request(
-            "GET",
-            f"/robustirc/v1/{self.session_id}/messages?lastseen=0.0",
-            self.auth, None)
-        return out if isinstance(out, list) else [out]
+    def messages(self, budget_s: float = 1.0) -> list:
+        """The real /messages endpoint is a never-closing long-poll
+        stream: read incrementally under a wall-clock budget, keeping
+        whatever parsed (`robustirc.clj:123-136` read-all)."""
+        import time as _t
+        req = urllib.request.Request(
+            self.base + f"/robustirc/v1/{self.session_id}"
+                        "/messages?lastseen=0.0",
+            headers={"X-Session-Auth": self.auth})
+        data = ""
+        t0 = _t.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=budget_s,
+                                        context=self.ctx) as r:
+                while _t.monotonic() - t0 < budget_s:
+                    chunk = r.read(4096)
+                    if not chunk:
+                        break
+                    data += chunk.decode()
+        except OSError:
+            pass  # stream timeout: keep what we have
+        docs = []
+        dec = json.JSONDecoder()
+        i = 0
+        while i < len(data):
+            while i < len(data) and data[i] in " \r\n\t":
+                i += 1
+            if i >= len(data):
+                break
+            try:
+                doc, i = dec.raw_decode(data, i)
+            except ValueError:
+                break  # trailing partial doc at the cut-off
+            docs.append(doc)
+        return docs
 
 
 def _is_topic(msg: dict) -> bool:

@@ -1,0 +1,103 @@
+"""In-process fake CrateDB: the HTTP `_sql` endpoint over a tiny
+store with per-row MVCC `_version` columns — the subset
+`jepsen_tpu/suites/crate.py` issues."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeCrate:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # table -> {id: {"cols": {...}, "_version": n}}
+        self.tables: dict[str, dict] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                try:
+                    with outer.lock:
+                        out = outer.sql(req.get("stmt", ""),
+                                        req.get("args") or [])
+                    body = json.dumps(out).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    body = json.dumps(
+                        {"error": {"code": 4000,
+                                   "message": str(e)}}).encode()
+                    self.send_response(400)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+    def sql(self, stmt: str, args: list) -> dict:
+        s = stmt.strip().rstrip(";")
+        low = s.lower()
+        if low.startswith("create table"):
+            name = re.match(
+                r"create table (?:if not exists )?(\w+)", low).group(1)
+            self.tables.setdefault(name, {})
+            return {"rowcount": 1, "rows": []}
+        if low.startswith("refresh table"):
+            return {"rowcount": 1, "rows": []}
+        m = re.match(r"insert into (\w+) \(([^)]*)\)\s*values", low)
+        if m:
+            tbl = self.tables.setdefault(m.group(1), {})
+            cols = [c.strip() for c in m.group(2).split(",")]
+            row = dict(zip(cols, args))
+            key = row.get("id")
+            if key in tbl:
+                raise ValueError("DuplicateKeyException")
+            tbl[key] = {"cols": row, "_version": 1}
+            return {"rowcount": 1, "rows": []}
+        m = re.match(
+            r"select (.*?) from (\w+)(?:\s+where id = (\?|\d+))?$",
+            low)
+        if m:
+            cols = [c.strip() for c in m.group(1).split(",")]
+            tbl = self.tables.setdefault(m.group(2), {})
+            if m.group(3) == "?":
+                key = args[0]
+                rows = [tbl[key]] if key in tbl else []
+            elif m.group(3):
+                key = int(m.group(3))
+                rows = [tbl[key]] if key in tbl else []
+            else:
+                rows = list(tbl.values())
+            out = [[r["_version"] if c.strip('\'"') == "_version"
+                    else r["cols"].get(c.strip('\'"')) for c in cols]
+                   for r in rows]
+            return {"rowcount": len(out), "rows": out}
+        m = re.match(
+            r"update (\w+) set (\w+) = \? where id = \?"
+            r"(?: and _version = \?)?$", low)
+        if m:
+            tbl = self.tables.setdefault(m.group(1), {})
+            col = m.group(2)
+            val, key = args[0], args[1]
+            row = tbl.get(key)
+            if row is None:
+                return {"rowcount": 0, "rows": []}
+            if "_version" in low and row["_version"] != args[2]:
+                return {"rowcount": 0, "rows": []}
+            row["cols"][col] = val
+            row["_version"] += 1
+            return {"rowcount": 1, "rows": []}
+        raise ValueError(f"unsupported statement: {stmt!r}")

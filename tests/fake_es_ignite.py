@@ -1,0 +1,171 @@
+"""In-process fakes for the elasticsearch REST subset (index docs,
+MVCC versioned puts, flush, search) and the Ignite REST API
+(get/put/cas/putifabs). Both consistent by construction."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeElasticsearch:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.docs: dict[tuple, dict] = {}  # (index, type, id) -> doc
+        self.auto = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_POST(self):  # noqa: N802
+                path = urllib.parse.urlparse(self.path).path
+                parts = [p for p in path.split("/") if p]
+                with outer.lock:
+                    if parts[-1] == "_flush":
+                        self._reply(200, {"ok": True})
+                        return
+                    # POST /{index}/{type}: auto-id create
+                    index, dtype = parts[0], parts[1]
+                    outer.auto += 1
+                    outer.docs[(index, dtype, str(outer.auto))] = {
+                        "_source": self._body(), "_version": 1}
+                    self._reply(201, {"created": True})
+
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                with outer.lock:
+                    if parts[-1] == "_search":
+                        index = parts[0]
+                        hits = [{"_id": k[2], "_source": d["_source"]}
+                                for k, d in outer.docs.items()
+                                if k[0] == index]
+                        self._reply(200, {"hits": {"hits": hits}})
+                        return
+                    key = (parts[0], parts[1], parts[2])
+                    doc = outer.docs.get(key)
+                    if doc is None:
+                        self._reply(404, {"found": False})
+                        return
+                    self._reply(200, {"found": True,
+                                      "_version": doc["_version"],
+                                      "_source": doc["_source"]})
+
+            def do_PUT(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                q = urllib.parse.parse_qs(parsed.query)
+                key = (parts[0], parts[1], parts[2])
+                with outer.lock:
+                    doc = outer.docs.get(key)
+                    if "create" in q.get("op_type", []):
+                        if doc is not None:
+                            self._reply(409, {"error": "exists"})
+                            return
+                        outer.docs[key] = {"_source": self._body(),
+                                           "_version": 1}
+                        self._reply(201, {"created": True})
+                        return
+                    if "version" in q:
+                        want = int(q["version"][0])
+                        if doc is None or doc["_version"] != want:
+                            self._reply(409, {"error": "conflict"})
+                            return
+                        doc["_source"] = self._body()
+                        doc["_version"] += 1
+                        self._reply(200, {"ok": True})
+                        return
+                    if doc is None:
+                        outer.docs[key] = {"_source": self._body(),
+                                           "_version": 1}
+                        self._reply(201, {"created": True})
+                    else:
+                        doc["_source"] = self._body()
+                        doc["_version"] += 1
+                        self._reply(200, {"ok": True})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+class FakeIgnite:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.caches: dict[str, dict] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                q = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(parsed.query).items()}
+                cmd = q.get("cmd")
+                cache = outer.caches.setdefault(
+                    q.get("cacheName", "default"), {})
+                with outer.lock:
+                    if cmd == "get":
+                        resp = cache.get(q["key"])
+                    elif cmd == "put":
+                        cache[q["key"]] = q["val"]
+                        resp = True
+                    elif cmd == "putifabs":
+                        if q["key"] in cache:
+                            resp = False
+                        else:
+                            cache[q["key"]] = q["val"]
+                            resp = True
+                    elif cmd == "cas":
+                        # val = new, val2 = expected old
+                        if str(cache.get(q["key"])) == q.get("val2"):
+                            cache[q["key"]] = q["val"]
+                            resp = True
+                        else:
+                            resp = False
+                    else:
+                        body = json.dumps(
+                            {"successStatus": 1,
+                             "error": f"bad cmd {cmd}"}).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                body = json.dumps({"successStatus": 0,
+                                   "response": resp}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()

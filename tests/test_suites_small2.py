@@ -1,0 +1,262 @@
+"""Small-suite sweep, batch 2: elasticsearch, crate, ignite, chronos."""
+
+import jepsen_tpu.db
+import jepsen_tpu.os_
+from fake_crate import FakeCrate
+from fake_es_ignite import FakeElasticsearch, FakeIgnite
+from jepsen_tpu import control, core
+from jepsen_tpu.control import dummy
+from jepsen_tpu.independent import ktuple
+from jepsen_tpu.suites import (chronos, crate, elasticsearch, ignite,
+                               suite)
+
+
+def test_suite_registry():
+    assert suite("elasticsearch") is elasticsearch
+    assert suite("crate") is crate
+    assert suite("ignite") is ignite
+    assert suite("chronos") is chronos
+
+
+def _hermetic(t, tmp_path, **conn):
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t.update(conn)
+    t["store-dir"] = str(tmp_path / "store")
+    return core.run(t)
+
+
+# -- elasticsearch -----------------------------------------------------------
+
+def test_es_create_set_and_cas_set_clients():
+    f = FakeElasticsearch()
+    try:
+        t = {"es-url-fn": lambda n: f"http://127.0.0.1:{f.port}"}
+        c = elasticsearch.CreateSetClient().open(t, "n1")
+        for v in (1, 2, 3):
+            assert c.invoke(t, {"type": "invoke", "f": "add",
+                                "value": v,
+                                "process": 0})["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "read", "value": None,
+                         "process": 0})
+        assert r["type"] == "ok" and r["value"] == [1, 2, 3]
+
+        c2 = elasticsearch.CASSetClient().open(t, "n1")
+        c2.setup(t)
+        assert c2.invoke(t, {"type": "invoke", "f": "add", "value": 9,
+                             "process": 0})["type"] == "ok"
+        r = c2.invoke(t, {"type": "invoke", "f": "read",
+                          "value": None, "process": 0})
+        assert r["value"] == [9]
+    finally:
+        f.stop()
+
+
+def test_es_hermetic_runs(tmp_path):
+    for workload in sorted(elasticsearch.WORKLOADS):
+        f = FakeElasticsearch()
+        try:
+            t = elasticsearch.elasticsearch_test({
+                "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "workload": workload,
+                "rate": 100, "time-limit": 3, "faults": ["none"]})
+            done = _hermetic(
+                t, tmp_path / workload,
+                **{"es-url-fn":
+                   lambda n: f"http://127.0.0.1:{f.port}"})
+            assert done["results"]["valid?"] is True, \
+                (workload, done["results"])
+        finally:
+            f.stop()
+
+
+# -- crate -------------------------------------------------------------------
+
+def test_crate_lost_updates_client():
+    f = FakeCrate()
+    try:
+        t = {"crate-url-fn": lambda n: f"http://127.0.0.1:{f.port}"}
+        c = crate.LostUpdatesClient().open(t, "n1")
+        for v in (0, 1, 2):
+            assert c.invoke(t, {"type": "invoke", "f": "add",
+                                "value": ktuple(1, v),
+                                "process": 0})["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "read",
+                         "value": ktuple(1, None), "process": 0})
+        assert r["type"] == "ok" and r["value"][1] == [0, 1, 2]
+    finally:
+        f.stop()
+
+
+def test_crate_version_divergence_checker():
+    h = [
+        {"type": "ok", "f": "read", "value": [3, 7], "process": 0,
+         "time": 0},
+        {"type": "ok", "f": "read", "value": [3, 7], "process": 1,
+         "time": 1},
+    ]
+    r = crate.MultiVersionChecker().check({}, h, {})
+    assert r["valid?"] is True
+    h.append({"type": "ok", "f": "read", "value": [4, 7],
+              "process": 2, "time": 2})
+    r = crate.MultiVersionChecker().check({}, h, {})
+    assert r["valid?"] is False and r["divergent"] == {7: [3, 4]}
+
+
+def test_crate_dirty_read_checker():
+    h = [
+        {"type": "ok", "f": "write", "value": 1, "process": 0},
+        {"type": "ok", "f": "write", "value": 2, "process": 0},
+        {"type": "ok", "f": "read", "value": 1, "process": 1},
+        {"type": "ok", "f": "strong-read", "value": [1, 2],
+         "process": 2},
+        {"type": "ok", "f": "strong-read", "value": [1, 2],
+         "process": 3},
+    ]
+    r = crate.DirtyReadChecker().check({}, h, {})
+    assert r["valid?"] is True
+    # a read of a row no strong read ever saw is dirty
+    h.append({"type": "ok", "f": "read", "value": 99, "process": 1})
+    r = crate.DirtyReadChecker().check({}, h, {})
+    assert r["valid?"] is False and r["dirty"] == [99]
+
+
+def test_crate_hermetic_runs(tmp_path):
+    for workload in ("lost-updates", "version-divergence"):
+        f = FakeCrate()
+        try:
+            t = crate.crate_test({
+                "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "workload": workload,
+                "rate": 100, "time-limit": 3, "faults": ["none"]})
+            done = _hermetic(
+                t, tmp_path / workload,
+                **{"crate-url-fn":
+                   lambda n: f"http://127.0.0.1:{f.port}"})
+            assert done["results"]["valid?"] is True, \
+                (workload, done["results"])
+        finally:
+            f.stop()
+
+
+def test_crate_dirty_read_hermetic(tmp_path):
+    f = FakeCrate()
+    try:
+        t = crate.crate_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+            "ssh": {"dummy": True}, "workload": "dirty-read",
+            "rate": 200, "time-limit": 3, "faults": ["none"],
+            "writers": 2})
+        done = _hermetic(
+            t, tmp_path,
+            **{"crate-url-fn": lambda n: f"http://127.0.0.1:{f.port}"})
+        w = done["results"]["workload"]
+        # reads may race ahead of the single fake's visibility, but
+        # nothing may be lost and strong reads must agree
+        assert w["nodes-agree?"] is True
+        assert not w["lost"], w
+    finally:
+        f.stop()
+
+
+# -- ignite ------------------------------------------------------------------
+
+def test_ignite_register_client():
+    f = FakeIgnite()
+    try:
+        t = {"ignite-url-fn": lambda n: f"http://127.0.0.1:{f.port}"}
+        c = ignite.RegisterClient().open(t, "n1")
+        assert c.invoke(t, {"type": "invoke", "f": "write",
+                            "value": ktuple(0, 3),
+                            "process": 0})["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "cas",
+                         "value": ktuple(0, (3, 4)), "process": 0})
+        assert r["type"] == "ok"
+        r = c.invoke(t, {"type": "invoke", "f": "cas",
+                         "value": ktuple(0, (9, 5)), "process": 0})
+        assert r["type"] == "fail"
+        r = c.invoke(t, {"type": "invoke", "f": "read",
+                         "value": ktuple(0, None), "process": 0})
+        assert r["value"][1] == 4
+    finally:
+        f.stop()
+
+
+def test_ignite_hermetic_runs(tmp_path):
+    for workload in sorted(ignite.WORKLOADS):
+        f = FakeIgnite()
+        try:
+            t = ignite.ignite_test({
+                "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+                "ssh": {"dummy": True}, "workload": workload,
+                "rate": 200, "accounts": [0, 1, 2, 3],
+                "time-limit": 3, "faults": ["none"]})
+            done = _hermetic(
+                t, tmp_path / workload,
+                **{"ignite-url-fn":
+                   lambda n: f"http://127.0.0.1:{f.port}"})
+            assert done["results"]["valid?"] is True, \
+                (workload, done["results"])
+        finally:
+            f.stop()
+
+
+# -- chronos -----------------------------------------------------------------
+
+def test_chronos_job_targets_and_solution():
+    job = {"name": 1, "start_epoch": 100.0, "count": 3,
+           "interval": 50, "epsilon": 10, "duration": 5}
+    targets = chronos.job_targets(300.0, job)
+    assert targets == [(100.0, 115.0), (150.0, 165.0), (200.0, 215.0)]
+    runs = [{"name": 1, "start": 101.0, "end": 106.0},
+            {"name": 1, "start": 152.0, "end": 157.0},
+            {"name": 1, "start": 203.0, "end": 208.0}]
+    s = chronos.job_solution(300.0, job, runs)
+    assert s["valid?"] is True and s["extra"] == []
+    # a missing run invalidates
+    s2 = chronos.job_solution(300.0, job, runs[:2])
+    assert s2["valid?"] is False
+    # an incomplete run doesn't count
+    runs[2] = {"name": 1, "start": 203.0, "end": None}
+    s3 = chronos.job_solution(300.0, job, runs)
+    assert s3["valid?"] is False and s3["incomplete"] == 1
+
+
+def test_chronos_checker_end_to_end():
+    job = {"name": 1, "start_epoch": 10.0, "count": 2,
+           "interval": 100, "epsilon": 10, "duration": 2}
+    hist = [
+        {"type": "ok", "f": "add-job", "value": job, "process": 0,
+         "time": 0},
+        {"type": "ok", "f": "read", "process": 0, "time": 1,
+         "read-time": 400.0,
+         "value": [
+             {"name": 1, "start": 12.0, "end": 14.0, "node": "n1"},
+             {"name": 1, "start": 111.0, "end": 113.0, "node": "n2"},
+             {"name": 1, "start": 250.0, "end": 252.0, "node": "n1"},
+         ]},
+    ]
+    r = chronos.JobRunChecker().check({}, hist, {})
+    assert r["valid?"] is True, r
+    # drop the second run: target unsatisfied
+    hist[1]["value"] = [hist[1]["value"][0], hist[1]["value"][2]]
+    r = chronos.JobRunChecker().check({}, hist, {})
+    assert r["valid?"] is False
+
+
+def test_chronos_db_commands():
+    log = []
+    remote = dummy.remote(log=log)
+    test = {"nodes": ["n1", "n2", "n3"]}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            chronos.db().setup(test, "n1")
+            chronos.db().teardown(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "service zookeeper restart" in cmds
+    assert "service mesos-master restart" in cmds
+    assert "service chronos restart" in cmds
+    stdins = " ".join(a.get("in", "") for _h, _c, a in log
+                      if isinstance(a.get("in"), str))
+    assert "zk://n1:2181,n2:2181,n3:2181/mesos" in stdins
