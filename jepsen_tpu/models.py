@@ -269,8 +269,35 @@ class FIFOQueue(Model):
         return inconsistent(f"unknown op f={f!r}")
 
 
+@dataclasses.dataclass(frozen=True)
+class MultiRegister(Model):
+    """Registers addressed by key, stepped by whole transactions: ops
+    carry `value` = [[f, k, v], ...] with f in {"r", "w"}. A nil read
+    is always legal. Mirrors the reference's MultiRegister knossos
+    model (`yugabyte/src/yugabyte/multi_key_acid.clj:16-38`)."""
+    values: tuple = ()   # sorted ((k, v), ...) so the model hashes
+
+    def step(self, op: dict):
+        state = dict(self.values)
+        for f, k, v in op["value"]:
+            if f in ("r", "read"):
+                if v is not None and state.get(k) != v:
+                    return inconsistent(
+                        f"can't read {v!r} from key {k!r} = "
+                        f"{state.get(k)!r}")
+            elif f in ("w", "write"):
+                state[k] = v
+            else:
+                return inconsistent(f"unknown micro-op f={f!r}")
+        return MultiRegister(tuple(sorted(state.items())))
+
+
 def cas_register(value: Any = None) -> CASRegister:
     return CASRegister(value)
+
+
+def multi_register(values: dict | None = None) -> MultiRegister:
+    return MultiRegister(tuple(sorted((values or {}).items())))
 
 
 def register(value: Any = None) -> Register:

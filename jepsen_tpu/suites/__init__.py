@@ -16,7 +16,7 @@ SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
           "stolon", "postgres_rds", "raftis", "mongodb", "aerospike",
           "mongodb_smartos", "logcabin", "robustirc",
           "mysql_cluster", "rethinkdb", "elasticsearch", "crate",
-          "ignite", "chronos")
+          "ignite", "chronos", "yugabyte")
 
 
 def suite(name: str):

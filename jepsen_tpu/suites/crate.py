@@ -222,11 +222,11 @@ class RWGen(gen.Gen):
         if p is None:
             return gen.PENDING, self
         n_nodes = len(test["nodes"])
-        node_ix = (p if isinstance(p, int) else 0) % n_nodes
         # crashed processes are replaced with higher ids: route by the
         # stable THREAD, as the reference does (`dirty_read.clj:216`)
         thread = gen.process_to_thread(ctx, p)
         thread = thread if isinstance(thread, int) else 0
+        node_ix = thread % n_nodes
         if thread < self.w:
             self.state["write"] += 1
             v = self.state["write"]

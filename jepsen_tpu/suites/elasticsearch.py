@@ -108,10 +108,14 @@ class ESClient(jclient.Client):
 
     def _req(self, method: str, path: str, body=None,
              ok_statuses=(200, 201)):
+        # str bodies go raw (ES 1.x scroll continuation takes the bare
+        # scroll id, not JSON — JSON bodies arrived in ES 2.0)
+        data = None
+        if body is not None:
+            data = body.encode() if isinstance(body, str) \
+                else json.dumps(body).encode()
         req = urllib.request.Request(
-            self.base + path,
-            data=json.dumps(body).encode() if body is not None else None,
-            method=method,
+            self.base + path, data=data, method=method,
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req,
@@ -152,8 +156,7 @@ class CreateSetClient(ESClient):
                     if sid is None:
                         break
                     status, out = self._req(
-                        "POST", "/_search/scroll",
-                        {"scroll": "10s", "scroll_id": sid})
+                        "POST", "/_search/scroll?scroll=10s", sid)
                     if status != 200:
                         return {**op, "type": "fail", "error": status}
                 return {**op, "type": "ok", "value": sorted(vals)}

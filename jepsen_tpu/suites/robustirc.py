@@ -168,7 +168,11 @@ class Session:
     def messages(self, budget_s: float = 1.0) -> list:
         """The real /messages endpoint is a never-closing long-poll
         stream: read incrementally under a wall-clock budget, keeping
-        whatever parsed (`robustirc.clj:123-136` read-all)."""
+        whatever parsed. This mirrors the reference's read-all exactly
+        — `(util/timeout 1000 @out ...)` returns whatever accumulated
+        and the read is still recorded :ok (`robustirc.clj:123-136`,
+        `:172-177`) — so, like the reference, a read the budget
+        truncated can under-report the set."""
         import time as _t
         req = urllib.request.Request(
             self.base + f"/robustirc/v1/{self.session_id}"

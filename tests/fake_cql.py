@@ -1,0 +1,340 @@
+"""An in-process CQL-binary-protocol (v4) server standing in for
+YugaByte's YCQL API: exercises the suite's wire client
+(`jepsen_tpu/suites/cql_proto.py`) against real framing, backed by a
+tiny linearizable CQL engine (single global lock; BEGIN/END TRANSACTION
+batches apply atomically). Supports exactly the statement shapes the
+yugabyte suite issues (`jepsen_tpu/suites/yugabyte.py`): CREATE
+KEYSPACE/TABLE/INDEX, USE, INSERT (upsert semantics, as in CQL),
+SELECT with =, IN and AND in WHERE, UPDATE with counter increments and
+IF conditions, and transaction batches.
+"""
+
+from __future__ import annotations
+
+import re
+import socketserver
+import struct
+import threading
+
+OP_ERROR, OP_STARTUP, OP_READY, OP_QUERY, OP_RESULT = (0x00, 0x01, 0x02,
+                                                       0x07, 0x08)
+T_BIGINT, T_BOOLEAN, T_COUNTER, T_INT, T_VARCHAR = (0x0002, 0x0004,
+                                                    0x0005, 0x0009,
+                                                    0x000D)
+
+_TYPES = {"int": T_INT, "bigint": T_BIGINT, "counter": T_COUNTER,
+          "boolean": T_BOOLEAN, "varchar": T_VARCHAR, "text": T_VARCHAR}
+
+
+class CQLFault(Exception):
+    def __init__(self, code: int, message: str):
+        self.code, self.message = code, message
+        super().__init__(message)
+
+
+def _literal(tok: str):
+    tok = tok.strip()
+    if tok.startswith("'") and tok.endswith("'"):
+        return tok[1:-1]
+    if tok.lstrip("-").isdigit():
+        return int(tok)
+    return tok
+
+
+_ARGSPLIT = re.compile(r",(?=(?:[^']*'[^']*')*[^']*$)")
+
+
+class Engine:
+    """Shared linearizable store."""
+
+    def __init__(self):
+        self.tables: dict[str, dict] = {}
+        self.lock = threading.RLock()
+
+    # -- DDL -----------------------------------------------------------------
+
+    def _create_table(self, m):
+        name, body = m.group(1), m.group(2)
+        name = name.split(".")[-1]
+        if name in self.tables:
+            return None
+        cols, types, pk = [], {}, []
+        for coldef in re.split(r",(?![^()]*\))", body):
+            coldef = coldef.strip()
+            if not coldef:
+                continue
+            mpk = re.match(r"primary key\s*\(([^)]*)\)", coldef, re.I)
+            if mpk:
+                pk = [c.strip() for c in mpk.group(1).split(",")]
+                continue
+            parts = coldef.split()
+            cname, ctype = parts[0], parts[1].lower()
+            cols.append(cname)
+            types[cname] = _TYPES.get(ctype, T_VARCHAR)
+            if "primary key" in coldef.lower():
+                pk = [cname]
+        self.tables[name] = {"cols": cols, "types": types,
+                             "pk": pk or [cols[0]], "rows": {}}
+        return None
+
+    def _table(self, name: str) -> dict:
+        name = name.split(".")[-1]
+        t = self.tables.get(name)
+        if t is None:
+            raise CQLFault(0x2200, f"table {name} does not exist")
+        return t
+
+    # -- WHERE parsing -------------------------------------------------------
+
+    @staticmethod
+    def _predicate(where: str | None):
+        if not where:
+            return lambda row: True
+        clauses = []
+        for part in re.split(r"\s+and\s+", where, flags=re.I):
+            part = part.strip()
+            min_ = re.match(r"(\w+)\s+in\s*\(([^)]*)\)", part, re.I)
+            if min_:
+                col = min_.group(1)
+                vals = {_literal(v) for v in min_.group(2).split(",")}
+                clauses.append((col, vals, True))
+                continue
+            meq = re.match(r"(\w+)\s*=\s*(.+)", part)
+            if not meq:
+                raise CQLFault(0x2000, f"bad where clause {part!r}")
+            clauses.append((meq.group(1), _literal(meq.group(2)), False))
+
+        def pred(row):
+            for col, v, is_in in clauses:
+                if is_in:
+                    if row.get(col) not in v:
+                        return False
+                elif row.get(col) != v:
+                    return False
+            return True
+        return pred
+
+    # -- statements ----------------------------------------------------------
+
+    def _insert(self, m):
+        t = self._table(m.group(1))
+        cnames = [c.strip() for c in m.group(2).split(",")]
+        values = [_literal(v) for v in _ARGSPLIT.split(m.group(3))]
+        row = dict(zip(cnames, values))
+        key = tuple(row.get(k) for k in t["pk"])
+        if key in t["rows"]:
+            t["rows"][key].update(row)   # CQL INSERT is an upsert
+        else:
+            t["rows"][key] = row
+        return None
+
+    def _select(self, m):
+        cols, name, where = m.group(1), m.group(2), m.group(3)
+        t = self._table(name)
+        pred = self._predicate(where)
+        rows = [r for r in t["rows"].values() if pred(r)]
+        out_cols = t["cols"] if cols.strip() == "*" else \
+            [c.strip() for c in cols.split(",")]
+        data = [[r.get(c) for c in out_cols] for r in rows]
+        types = [t["types"].get(c, T_VARCHAR) for c in out_cols]
+        return data, out_cols, types
+
+    def _update(self, m):
+        name, assigns, where, cond = (m.group(1), m.group(2), m.group(3),
+                                      m.group(4))
+        t = self._table(name)
+        pred = self._predicate(where)
+        hits = [r for r in t["rows"].values() if pred(r)]
+        if not hits and not cond:
+            # CQL UPDATE on a missing row creates it (counter semantics);
+            # synthesize the row from the WHERE equality clauses.
+            row = {}
+            for part in re.split(r"\s+and\s+", where or "", flags=re.I):
+                meq = re.match(r"(\w+)\s*=\s*(.+)", part.strip())
+                if meq:
+                    row[meq.group(1)] = _literal(meq.group(2))
+            key = tuple(row.get(k) for k in t["pk"])
+            t["rows"][key] = row
+            hits = [row]
+        if cond:
+            mc = re.match(r"(\w+)\s*=\s*(.+)", cond.strip())
+            ccol, cval = mc.group(1), _literal(mc.group(2))
+            applied = bool(hits) and all(r.get(ccol) == cval
+                                         for r in hits)
+            if not applied:
+                cur = hits[0].get(ccol) if hits else None
+                return ([[False, cur]], ["[applied]", ccol],
+                        [T_BOOLEAN, t["types"].get(ccol, T_VARCHAR)])
+        for r in hits:
+            for assign in _ARGSPLIT.split(assigns):
+                col, expr = assign.split("=", 1)
+                col, expr = col.strip(), expr.strip()
+                marith = re.match(rf"{col}\s*([+-])\s*(\d+)$", expr)
+                if marith:
+                    base = int(r.get(col) or 0)
+                    d = int(marith.group(2))
+                    r[col] = base + d if marith.group(1) == "+" \
+                        else base - d
+                else:
+                    r[col] = _literal(expr)
+        if cond:
+            return [[True]], ["[applied]"], [T_BOOLEAN]
+        return None
+
+    _CREATE_RE = re.compile(
+        r"create table (?:if not exists )?([\w.]+)\s*\((.*)\)"
+        r"\s*(?:with\s+.*)?$", re.I | re.S)
+    _INSERT_RE = re.compile(
+        r"insert into ([\w.]+)\s*\(([^)]*)\)\s*values\s*\((.*)\)\s*$",
+        re.I | re.S)
+    _SELECT_RE = re.compile(
+        r"select\s+(.*?)\s+from\s+([\w.]+)(?:\s+where\s+(.*?))?\s*$",
+        re.I | re.S)
+    _UPDATE_RE = re.compile(
+        r"update ([\w.]+)\s+set\s+(.*?)(?:\s+where\s+(.*?))?"
+        r"(?:\s+if\s+(.*?))?\s*$", re.I | re.S)
+
+    def execute(self, cql: str):
+        """Returns None for void results or (rows, cols, types)."""
+        cql = cql.strip().rstrip(";").strip()
+        low = cql.lower()
+        with self.lock:
+            if low.startswith("begin transaction"):
+                body = re.sub(r"end transaction$", "",
+                              re.sub(r"^begin transaction", "", cql,
+                                     flags=re.I),
+                              flags=re.I)
+                for stmt in body.split(";"):
+                    if stmt.strip():
+                        self.execute(stmt)
+                return None
+            if low.startswith(("create keyspace", "create index", "use ",
+                               "drop index")):
+                return None
+            m = self._CREATE_RE.match(cql)
+            if m:
+                return self._create_table(m)
+            m = self._INSERT_RE.match(cql)
+            if m:
+                return self._insert(m)
+            m = self._SELECT_RE.match(cql)
+            if m:
+                return self._select(m)
+            m = self._UPDATE_RE.match(cql)
+            if m:
+                return self._update(m)
+            raise CQLFault(0x2000, f"unsupported statement: {cql!r}")
+
+
+# ---------------------------------------------------------------------------
+# wire server
+# ---------------------------------------------------------------------------
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!H", len(b)) + b
+
+
+def _encode_value(tid: int, v) -> bytes:
+    if v is None:
+        return struct.pack("!i", -1)
+    if tid == T_INT:
+        return struct.pack("!ii", 4, int(v))
+    if tid in (T_BIGINT, T_COUNTER):
+        return struct.pack("!iq", 8, int(v))
+    if tid == T_BOOLEAN:
+        return struct.pack("!iB", 1, 1 if v else 0)
+    b = str(v).encode()
+    return struct.pack("!i", len(b)) + b
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    def _frame(self, stream: int, opcode: int, body: bytes) -> None:
+        self.request.sendall(
+            struct.pack("!BBhBI", 0x84, 0x00, stream, opcode, len(body))
+            + body)
+
+    def _error(self, stream: int, code: int, msg: str) -> None:
+        self._frame(stream, OP_ERROR,
+                    struct.pack("!i", code) + _string(msg))
+
+    def _rows(self, stream: int, rows, cols, types) -> None:
+        body = struct.pack("!iii", 0x0002, 0x0001, len(cols))
+        body += _string("jepsen") + _string("t")
+        for c, tid in zip(cols, types):
+            body += _string(c) + struct.pack("!H", tid)
+        body += struct.pack("!i", len(rows))
+        for r in rows:
+            for tid, v in zip(types, r):
+                body += _encode_value(tid, v)
+        self._frame(stream, OP_RESULT, body)
+
+    def handle(self):
+        server: FakeCQLServer = self.server.outer   # type: ignore
+        while True:
+            try:
+                hdr = self._recv_exact(9)
+            except (ConnectionError, OSError):
+                return
+            _ver, _flags, stream, opcode, length = struct.unpack(
+                "!BBhBI", hdr)
+            body = self._recv_exact(length)
+            if opcode == OP_STARTUP:
+                self._frame(stream, OP_READY, b"")
+                continue
+            if opcode != OP_QUERY:
+                self._error(stream, 0x000A,
+                            f"unsupported opcode {opcode}")
+                continue
+            (qlen,) = struct.unpack("!i", body[:4])
+            cql = body[4:4 + qlen].decode()
+            hook = server.fail_hook
+            if hook:
+                fault = hook(cql)
+                if fault:
+                    code, msg = fault
+                    self._error(stream, code, msg)
+                    continue
+            try:
+                res = server.engine.execute(cql)
+            except CQLFault as e:
+                self._error(stream, e.code, e.message)
+                continue
+            if res is None:
+                self._frame(stream, OP_RESULT,
+                            struct.pack("!i", 0x0001))   # void
+            else:
+                self._rows(stream, *res)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakeCQLServer:
+    """One fake YCQL endpoint; all connections share the engine.
+    `fail_hook(cql) -> (code, message) | None` injects errors."""
+
+    def __init__(self):
+        self.engine = Engine()
+        self.fail_hook = None
+        self._srv = _Server(("127.0.0.1", 0), _Handler)
+        self._srv.outer = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

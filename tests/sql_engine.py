@@ -139,7 +139,7 @@ class Session:
         name, body = m.group(1), m.group(2)
         if name in self.engine.tables:
             return 0, None
-        cols, pk, auto = [], None, None
+        cols, pk, auto, defaults = [], None, None, {}
         for coldef in re.split(r",(?![^()]*\))", body):
             coldef = coldef.strip()
             if not coldef or coldef.lower().startswith(("primary key",
@@ -155,9 +155,12 @@ class Session:
             if "auto_increment" in coldef.lower() or \
                     "serial" in coldef.lower():
                 auto = cname
+            mdef = re.search(r"default\s+(\S+)", coldef, re.I)
+            if mdef:
+                defaults[cname] = _literal(mdef.group(1))
         self.engine.tables[name] = {
             "cols": cols, "pk": pk, "auto": auto, "next": 1, "rows": {},
-            "seq": 0}
+            "seq": 0, "defaults": defaults}
         return 0, None
 
     def _table(self, name):
@@ -173,7 +176,8 @@ class Session:
         t = self._table(name)
         cnames = [c.strip() for c in cols.split(",")]
         values = [_literal(v) for v in _ARGSPLIT.split(vals)]
-        row = dict(zip(cnames, values))
+        row = dict(t.get("defaults") or {})
+        row.update(dict(zip(cnames, values)))
         if t["auto"] and t["auto"] not in row:
             row[t["auto"]] = t["next"]
             t["next"] += 1
@@ -244,7 +248,9 @@ class Session:
         n = 0
         for r in t["rows"].values():
             if r.get(wcol) == wv:
-                for assign in assigns.split(","):
+                # split assignments on commas outside parens, so
+                # concat(a, ',', b) survives intact
+                for assign in re.split(r",(?![^()]*\))", assigns):
                     col, expr = assign.split("=", 1)
                     col = col.strip()
                     expr = expr.strip()

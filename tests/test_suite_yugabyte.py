@@ -1,0 +1,288 @@
+"""YugaByte suite tests: dual-API workload menu, master/tserver DB
+automation against the recording dummy remote, the CQL wire client
+against an in-process protocol fake, error classification, the
+master/tserver process nemesis, and complete hermetic suite runs over
+both the YCQL (fake CQL server) and YSQL (fake Postgres server) data
+planes."""
+
+import pytest
+
+from fake_cql import FakeCQLServer
+from fake_pg import FakePGServer
+
+from jepsen_tpu import control, core, models
+from jepsen_tpu.control import dummy
+from jepsen_tpu.suites import suite, yugabyte
+from jepsen_tpu.suites.cql_proto import CQLError, Conn
+from jepsen_tpu.suites.cql_proto import ERR_WRITE_TIMEOUT, ERR_UNAVAILABLE
+
+
+@pytest.fixture
+def fake():
+    f = FakeCQLServer()
+    yield f
+    f.stop()
+
+
+@pytest.fixture
+def fake_pg():
+    f = FakePGServer()
+    yield f
+    f.stop()
+
+
+def cql_conn_fn(fake):
+    return lambda node: Conn("127.0.0.1", fake.port)
+
+
+def pg_conn_fn(fake_pg):
+    from jepsen_tpu.suites.pg_proto import Conn as PGConn
+    return lambda node: PGConn("127.0.0.1", fake_pg.port)
+
+
+def test_suite_registry():
+    assert suite("yugabyte") is yugabyte
+
+
+def test_master_nodes():
+    t = {"nodes": ["n1", "n2", "n3", "n4", "n5"],
+         "replication-factor": 3}
+    assert yugabyte.master_nodes(t) == ["n1", "n2", "n3"]
+    assert yugabyte.master_addresses(t) == "n1:7100,n2:7100,n3:7100"
+    assert yugabyte.master_node(t, "n2")
+    assert not yugabyte.master_node(t, "n5")
+
+
+def test_db_setup_commands():
+    """Masters start on the first RF nodes with --master_addresses and
+    --replication_factor; tservers everywhere with
+    --tserver_master_addrs; ysql adds the pgsql proxy flags
+    (`auto.clj:334-413`)."""
+    log = []
+    remote = dummy.remote(
+        log=log, responses={r"ls -A \.": "yugabyte-1.3.1.0"})
+    test = {"nodes": ["n1", "n2", "n3", "n4"], "replication-factor": 3,
+            "tarball": "file:///tmp/yb.tgz", "api": "ysql"}
+    with control.with_remote(remote):
+        sess = control.session("n1")
+        with control.with_session("n1", sess):
+            yugabyte.db().setup(test, "n1")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "post_install.sh" in cmds
+    assert "yb-master" in cmds
+    assert "--master_addresses n1:7100,n2:7100,n3:7100" in cmds
+    assert "--replication_factor 3" in cmds
+    assert "--tserver_master_addrs n1:7100,n2:7100,n3:7100" in cmds
+    assert "--start_pgsql_proxy" in cmds
+    assert "limits.d/jepsen.conf" in cmds
+    # n4 is not a master: no yb-master daemon start
+    log.clear()
+    with control.with_remote(remote):
+        sess = control.session("n4")
+        with control.with_session("n4", sess):
+            yugabyte.db().setup(test, "n4")
+    cmds = " ; ".join(a.get("cmd", "") for _h, _c, a in log)
+    assert "yb-master" not in cmds.replace("yb-master.pid", "")
+    assert "yb-tserver" in cmds
+
+
+def test_cql_client_roundtrip(fake):
+    c = Conn("127.0.0.1", fake.port)
+    c.query("CREATE TABLE IF NOT EXISTS jepsen.t "
+            "(id INT PRIMARY KEY, val INT)")
+    c.query("INSERT INTO jepsen.t (id, val) VALUES (1, 5)")
+    rows, cols = c.query("SELECT val FROM jepsen.t WHERE id = 1")
+    assert rows == [[5]] and cols == ["val"]
+    # CQL insert is an upsert
+    c.query("INSERT INTO jepsen.t (id, val) VALUES (1, 7)")
+    rows, _ = c.query("SELECT val FROM jepsen.t WHERE id = 1")
+    assert rows == [[7]]
+    # conditional update: applied + not-applied
+    rows, cols = c.query("UPDATE jepsen.t SET val = 9 WHERE id = 1 "
+                         "IF val = 7")
+    assert rows[0][cols.index("[applied]")] is True
+    rows, cols = c.query("UPDATE jepsen.t SET val = 9 WHERE id = 1 "
+                         "IF val = 3")
+    assert rows[0][cols.index("[applied]")] is False
+    # counters
+    c.query("CREATE TABLE jepsen.counter (id INT PRIMARY KEY, "
+            "count COUNTER)")
+    c.query("UPDATE jepsen.counter SET count = count + 5 WHERE id = 0")
+    c.query("UPDATE jepsen.counter SET count = count - 2 WHERE id = 0")
+    rows, _ = c.query("SELECT count FROM jepsen.counter WHERE id = 0")
+    assert rows == [[3]]
+    with pytest.raises(CQLError):
+        c.query("bogus statement")
+    c.close()
+
+
+def test_cql_transaction_batch(fake):
+    c = Conn("127.0.0.1", fake.port)
+    c.query("CREATE TABLE jepsen.accounts (id INT PRIMARY KEY, "
+            "balance BIGINT)")
+    c.query("INSERT INTO jepsen.accounts (id, balance) VALUES (0, 10)")
+    c.query("INSERT INTO jepsen.accounts (id, balance) VALUES (1, 0)")
+    c.query("BEGIN TRANSACTION "
+            "UPDATE jepsen.accounts SET balance = balance - 3 "
+            "WHERE id = 0;"
+            "UPDATE jepsen.accounts SET balance = balance + 3 "
+            "WHERE id = 1;"
+            "END TRANSACTION;")
+    rows, _ = c.query("SELECT id, balance FROM jepsen.accounts")
+    assert {r[0]: r[1] for r in rows} == {0: 7, 1: 3}
+    c.close()
+
+
+def test_cql_error_classification(fake):
+    """Timeouts on writes are indeterminate; on reads they fail;
+    unavailable always fails; definite-conflict messages fail
+    (`ycql/client.clj:197-245`)."""
+    t = {"cql-conn-fn": cql_conn_fn(fake), "accounts": [0, 1],
+         "total-amount": 20}
+    c = yugabyte.CQLBank().open(t, "n1")
+    c.setup(t)
+
+    fake.fail_hook = lambda cql: (ERR_WRITE_TIMEOUT, "write timed out") \
+        if "BEGIN TRANSACTION" in cql else None
+    r = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                     "value": {"from": 0, "to": 1, "amount": 5}})
+    assert r["type"] == "info"
+
+    fake.fail_hook = lambda cql: (ERR_WRITE_TIMEOUT, "timed out") \
+        if "SELECT" in cql else None
+    r = c.invoke(t, {"type": "invoke", "f": "read", "process": 0})
+    assert r["type"] == "fail"
+
+    fake.fail_hook = lambda cql: (ERR_UNAVAILABLE, "not enough replicas") \
+        if "BEGIN TRANSACTION" in cql else None
+    r = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                     "value": {"from": 0, "to": 1, "amount": 5}})
+    assert r["type"] == "fail"
+
+    fake.fail_hook = lambda cql: \
+        (0x0000, "Conflicts with committed transaction x") \
+        if "BEGIN TRANSACTION" in cql else None
+    r = c.invoke(t, {"type": "invoke", "f": "transfer", "process": 0,
+                     "value": {"from": 0, "to": 1, "amount": 5}})
+    assert r["type"] == "fail"
+    fake.fail_hook = None
+
+
+def test_cql_single_key_cas(fake):
+    from jepsen_tpu.independent import ktuple
+    t = {"cql-conn-fn": cql_conn_fn(fake)}
+    c = yugabyte.CQLSingleKey().open(t, "n1")
+    c.setup(t)
+    assert c.invoke(t, {"type": "invoke", "f": "write", "process": 0,
+                        "value": (3, 1)})["type"] == "ok"
+    assert c.invoke(t, {"type": "invoke", "f": "cas", "process": 0,
+                        "value": (3, (1, 4))})["type"] == "ok"
+    assert c.invoke(t, {"type": "invoke", "f": "cas", "process": 0,
+                        "value": (3, (1, 2))})["type"] == "fail"
+    r = c.invoke(t, {"type": "invoke", "f": "read", "process": 0,
+                     "value": (3, None)})
+    assert r["type"] == "ok" and r["value"] == ktuple(3, 4)
+
+
+def test_multi_register_model():
+    m = models.multi_register()
+    m2 = m.step({"value": [["w", 0, 1], ["w", 2, 3]]})
+    assert not models.is_inconsistent(m2)
+    ok = m2.step({"value": [["r", 0, 1], ["r", 2, 3]]})
+    assert not models.is_inconsistent(ok)
+    bad = m2.step({"value": [["r", 0, 2]]})
+    assert models.is_inconsistent(bad)
+    # nil reads are always legal
+    assert not models.is_inconsistent(m.step({"value": [["r", 1, None]]}))
+
+
+def test_process_nemesis_targets_masters():
+    """kill-master only touches master nodes; start-tserver heals
+    every node (`nemesis.clj:18-45`)."""
+    log = []
+    remote = dummy.remote(log=log)
+    db_ = yugabyte.db()
+    test = {"nodes": ["n1", "n2", "n3", "n4"], "replication-factor": 3,
+            "db": db_, "api": "ycql"}
+    with control.with_remote(remote):
+        test["sessions"] = {n: control.session(n) for n in test["nodes"]}
+        nem = yugabyte.ProcessNemesis()
+        done = nem.invoke(test, {"type": "info", "f": "kill-master",
+                                 "value": None})
+        assert set(done["value"]) <= {"n1", "n2", "n3"}
+        done = nem.invoke(test, {"type": "info", "f": "start-tserver",
+                                 "value": None})
+        assert set(done["value"]) == {"n1", "n2", "n3", "n4"}
+
+
+def test_nemesis_package_menu():
+    pkg = yugabyte.nemesis_package(
+        {"faults": ["kill-tserver", "partition", "clock"]})
+    fs = pkg["nemesis"].fs()
+    assert "kill-tserver" in fs and "start-partition" in fs \
+        and "bump" in fs
+    assert pkg["generator"] is not None
+    assert pkg["final-generator"]
+
+
+def test_workload_menu_is_dual_api():
+    names = set(yugabyte.WORKLOADS)
+    assert {"ycql/bank", "ycql/counter", "ycql/set", "ycql/set-index",
+            "ycql/long-fork", "ycql/single-key-acid",
+            "ycql/multi-key-acid", "ysql/bank", "ysql/bank-multitable",
+            "ysql/counter", "ysql/set", "ysql/long-fork",
+            "ysql/single-key-acid", "ysql/multi-key-acid",
+            "ysql/append", "ysql/default-value"} <= names
+
+
+def _run_opts(tmp_path, workload):
+    return {
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 6,
+        "ssh": {"dummy": True},
+        "workload": workload,
+        "rate": 500,
+        "time-limit": 3,
+        "faults": ["none"],
+        # drop the reference's 1 s per-key stagger
+        # (`single_key_acid.clj:40`) so 3 s yields a real history
+        "acid-stagger": 0.01,
+        "store-dir": str(tmp_path / "store"),
+    }
+
+
+YCQL_WORKLOADS = sorted(w for w in yugabyte.WORKLOADS
+                        if w.startswith("ycql/"))
+YSQL_WORKLOADS = sorted(w for w in yugabyte.WORKLOADS
+                        if w.startswith("ysql/"))
+
+
+@pytest.mark.parametrize("workload", YCQL_WORKLOADS)
+def test_hermetic_ycql_run(tmp_path, fake, workload):
+    """End to end over the fake CQL server: linearizable by
+    construction, so every workload must verify."""
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    t = yugabyte.yugabyte_test(_run_opts(tmp_path, workload))
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["cql-conn-fn"] = cql_conn_fn(fake)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert len(done["history"]) > 10
+
+
+@pytest.mark.parametrize("workload", YSQL_WORKLOADS)
+def test_hermetic_ysql_run(tmp_path, fake_pg, workload):
+    """End to end over the fake Postgres server."""
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    t = yugabyte.yugabyte_test(_run_opts(tmp_path, workload))
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["sql-conn-fn"] = pg_conn_fn(fake_pg)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    assert len(done["history"]) > 10
