@@ -1,0 +1,199 @@
+"""Distributed tracing for tests and clients (the reference's
+OpenCensus→Jaeger wiring, `dgraph/src/jepsen/dgraph/trace.clj:1-73`,
+re-designed dependency-free).
+
+The reference builds spans with the OpenCensus tracer and exports them
+to a Jaeger collector. Here a tracer is a contextvar-scoped span stack:
+`span("name")` opens a scoped span (the `with-trace` macro), `annotate`
+/ `attribute` decorate the current span (`trace.clj:59-73`), and
+`context()` returns the {span-id, trace-id} map workloads attach to
+checker violations (`trace.clj:51-57`, used by `bank.clj:160-166`).
+
+Finished spans are recorded Jaeger-JSON-shaped and exported either to
+an in-memory buffer (always), a JSONL file (endpoint = a filesystem
+path), or an HTTP collector (endpoint = http(s) URL, posted
+best-effort in Jaeger's /api/traces JSON format). Sampling follows the
+reference: enabled iff an endpoint is configured (`trace.clj:9-14`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import random
+import threading
+import time
+import urllib.request
+from typing import Any
+
+_stack: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "trace_stack", default=())
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_us",
+                 "duration_us", "tags", "logs")
+
+    def __init__(self, name: str, trace_id: str, parent_id: str | None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = f"{random.getrandbits(64):016x}"
+        self.parent_id = parent_id
+        self.start_us = int(time.time() * 1e6)
+        self.duration_us = 0
+        self.tags: dict[str, str] = {}
+        self.logs: list[dict] = []
+
+    def to_jaeger(self) -> dict:
+        """One span in Jaeger JSON shape."""
+        return {
+            "traceID": self.trace_id,
+            "spanID": self.span_id,
+            "parentSpanID": self.parent_id or "",
+            "operationName": self.name,
+            "startTime": self.start_us,
+            "duration": self.duration_us,
+            "tags": [{"key": k, "type": "string", "value": v}
+                     for k, v in self.tags.items()],
+            "logs": self.logs,
+            "process": {"serviceName": "jepsen"},
+        }
+
+
+class Tracer:
+    """Sampler + exporter. `endpoint=None` disables sampling — spans
+    become no-ops, mirroring `Samplers/neverSample`
+    (`trace.clj:9-14`)."""
+
+    def __init__(self, endpoint: str | None = None,
+                 buffer_limit: int = 100_000):
+        self.endpoint = endpoint
+        self.enabled = endpoint is not None
+        self.buffer: list[dict] = []
+        self.buffer_limit = buffer_limit
+        self.lock = threading.Lock()
+        self._file = None
+        if self.enabled and not str(endpoint).startswith(
+                ("http://", "https://")):
+            self._file = open(endpoint, "a", encoding="utf8")  # noqa: SIM115 — long-lived exporter
+
+    # -- span lifecycle ------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Scoped span (the `with-trace` macro, `trace.clj:40-49`)."""
+        if not self.enabled:
+            yield None
+            return
+        stack = _stack.get()
+        parent = stack[-1] if stack else None
+        trace_id = parent.trace_id if parent \
+            else f"{random.getrandbits(128):032x}"
+        sp = Span(name, trace_id, parent.span_id if parent else None)
+        token = _stack.set(stack + (sp,))
+        t0 = time.monotonic()
+        try:
+            yield sp
+        finally:
+            sp.duration_us = int((time.monotonic() - t0) * 1e6)
+            _stack.reset(token)
+            self._record(sp)
+
+    def current(self) -> Span | None:
+        stack = _stack.get()
+        return stack[-1] if stack else None
+
+    def context(self) -> dict:
+        """{span-id, trace-id} of the current span (`trace.clj:51-57`)."""
+        sp = self.current()
+        if sp is None:
+            return {"span-id": None, "trace-id": None}
+        return {"span-id": sp.span_id, "trace-id": sp.trace_id}
+
+    def annotate(self, message: str) -> None:
+        """`trace.clj:59-63`."""
+        sp = self.current()
+        if sp is not None:
+            sp.logs.append({"timestamp": int(time.time() * 1e6),
+                            "fields": [{"key": "message",
+                                        "value": str(message)}]})
+
+    def attribute(self, k: str, v: Any) -> None:
+        """Keys and values are coerced to strings, as opencensus
+        requires (`trace.clj:65-73`)."""
+        sp = self.current()
+        if sp is not None:
+            sp.tags[str(k)] = str(v)
+
+    # -- export --------------------------------------------------------------
+
+    def _record(self, sp: Span) -> None:
+        doc = sp.to_jaeger()
+        with self.lock:
+            if len(self.buffer) < self.buffer_limit:
+                self.buffer.append(doc)
+            if self._file is not None:
+                self._file.write(json.dumps(doc) + "\n")
+                self._file.flush()
+        if self._file is None and self.enabled:
+            self._post([doc])
+
+    def _post(self, docs: list[dict]) -> None:
+        """Best-effort POST to a Jaeger-style HTTP collector."""
+        try:
+            body = json.dumps({"data": [{
+                "traceID": docs[0]["traceID"], "spans": docs}]}).encode()
+            req = urllib.request.Request(
+                self.endpoint, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=1.0).close()
+        except OSError:
+            pass   # tracing must never fail an op
+
+    def spans(self, name: str | None = None) -> list[dict]:
+        with self.lock:
+            if name is None:
+                return list(self.buffer)
+            return [s for s in self.buffer if s["operationName"] == name]
+
+    def close(self) -> None:
+        with self.lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# -- module-level default tracer (what suites import) ------------------------
+
+_default = Tracer(None)
+
+
+def tracing(endpoint: str | None) -> dict:
+    """Install the default tracer for an endpoint; returns the config
+    map stored on the test (`trace.clj:34-38`)."""
+    global _default
+    _default.close()
+    _default = Tracer(endpoint)
+    return {"endpoint": endpoint, "config": _default.enabled,
+            "exporter": _default}
+
+
+def tracer() -> Tracer:
+    return _default
+
+
+def span(name: str):
+    return _default.span(name)
+
+
+def context() -> dict:
+    return _default.context()
+
+
+def annotate(message: str) -> None:
+    _default.annotate(message)
+
+
+def attribute(k: str, v: Any) -> None:
+    _default.attribute(k, v)
