@@ -150,10 +150,43 @@ class Conn:
         return buf
 
     def _recv_frame(self) -> tuple[int, bytes]:
-        hdr = self._recv_exact(9)
-        _ver, _flags, _stream, opcode, length = struct.unpack("!BBhBI",
-                                                              hdr)
-        return opcode, self._recv_exact(length)
+        """Read frames until one matches the request's stream id.
+
+        Stale frames (late responses to an earlier, abandoned request)
+        and EVENT pushes (stream -1) are discarded rather than being
+        misread as the current query's result — the correlation the
+        reference gets for free from the DataStax driver. Flag bits
+        that prepend sections to the body (tracing 0x02, custom
+        payload 0x04, warning 0x08) are stripped so result offsets
+        stay correct."""
+        for _ in range(32):  # bounded: a stale backlog can't spin forever
+            hdr = self._recv_exact(9)
+            _ver, flags, stream, opcode, length = struct.unpack(
+                "!BBhBI", hdr)
+            body = self._recv_exact(length)
+            if stream != self._stream:
+                continue  # EVENT (-1) or stale response: drop
+            if flags & 0x01:
+                raise ConnectionError("unexpected compressed frame")
+            pos = 0
+            if flags & 0x02:  # tracing id: [uuid]
+                pos += 16
+            if flags & 0x08:  # warnings: [string list] (before payload)
+                (n,) = struct.unpack("!H", body[pos:pos + 2])
+                pos += 2
+                for _i in range(n):
+                    (slen,) = struct.unpack("!H", body[pos:pos + 2])
+                    pos += 2 + slen
+            if flags & 0x04:  # custom payload: [bytes map]
+                (n,) = struct.unpack("!H", body[pos:pos + 2])
+                pos += 2
+                for _i in range(n):
+                    (klen,) = struct.unpack("!H", body[pos:pos + 2])
+                    pos += 2 + klen
+                    (vlen,) = struct.unpack("!i", body[pos:pos + 4])
+                    pos += 4 + max(vlen, 0)
+            return opcode, body[pos:]
+        raise ConnectionError("no frame for current stream id after 32 reads")
 
     # -- handshake -----------------------------------------------------------
 

@@ -267,6 +267,20 @@ def _q(v) -> str:
     return f"'{s}'"
 
 
+def _close_quietly(conn) -> None:
+    if conn is not None:
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001 — already broken
+            pass
+
+
+def _reconn_fail(op) -> dict:
+    # A failed reconnect proves the op was never sent, so "fail" is
+    # safe for reads and writes alike.
+    return {**op, "type": "fail", "error": ["conn", "reconnect-failed"]}
+
+
 class _CQLClient(jclient.Client):
     """Shared open/close + the with-errors classification
     (`ycql/client.clj:197-245`): unavailable -> fail; timeouts ->
@@ -282,12 +296,23 @@ class _CQLClient(jclient.Client):
     def open(self, test, node):
         c = type(self).__new__(type(self))
         c.__dict__.update(self.__dict__)
+        c.node = node
         c.conn = _cql_connect(test, node)
         return c
 
     def close(self, test):
         if self.conn is not None:
             self.conn.close()
+
+    def _drop_conn(self):
+        """Discard a desynchronized connection. After a socket-level
+        timeout the server's late response frame would otherwise be
+        read as the next query's result (the raw socket has no
+        stream-id correlation the way the reference's DataStax driver
+        does, `ycql/client.clj:197`), so the socket must never be
+        reused."""
+        _close_quietly(self.conn)
+        self.conn = None
 
     def _ensure_keyspace(self, test):
         self.conn.query(
@@ -297,6 +322,13 @@ class _CQLClient(jclient.Client):
 
     def invoke(self, test, op):
         crash = "fail" if op["f"] in self.idempotent else "info"
+        if self.conn is None:
+            try:
+                self.conn = _cql_connect(test, self.node)
+            except (ConnectionError, OSError, CQLError) as e:
+                # CQLError covers an ERROR frame during STARTUP — a
+                # recovering tserver answering Overloaded/ServerError.
+                return {**op, "type": "fail", "error": ["conn", str(e)]}
         try:
             return self._invoke(test, op)
         except CQLError as e:
@@ -317,6 +349,7 @@ class _CQLClient(jclient.Client):
             return {**op, "type": crash,
                     "error": ["cql", e.code, e.message]}
         except (ConnectionError, OSError) as e:
+            self._drop_conn()
             return {**op, "type": crash, "error": ["conn", str(e)]}
 
     def _invoke(self, test, op):
@@ -590,6 +623,8 @@ class _YSQLClient(jclient.Client):
     def open(self, test, node):
         c = type(self).__new__(type(self))
         c.__dict__.update(self.__dict__)
+        c.node = node
+        c._test = test
         c.conn = _ysql_connect(test, node)
         return c
 
@@ -597,8 +632,32 @@ class _YSQLClient(jclient.Client):
         if self.conn is not None:
             self.conn.close()
 
+    def _drop_conn(self):
+        """Discard a connection after a socket-level error: a late
+        response to a timed-out query would otherwise corrupt the next
+        query's result. The reference routes ysql conns through
+        jepsen.reconnect for the same reason (`ysql/client.clj:60`)."""
+        _close_quietly(self.conn)
+        self.conn = None
+
+    def _ensure_conn(self) -> bool:
+        if self.conn is None:
+            try:
+                self.conn = _ysql_connect(self._test, self.node)
+            except (ConnectionError, OSError, PGError):
+                # PGError covers a failed startup handshake — a
+                # recovering node answering 57P03 "starting up" or
+                # closing the socket mid-handshake (08006).
+                self.conn = None
+                return False
+        return True
+
     def _capture(self, op, e: Exception, read_only: bool) -> dict:
-        if isinstance(e, PGError):
+        # SQLSTATE class 08 is a connection exception (pg_proto
+        # synthesizes 08006 when the server closes the socket
+        # mid-response) — socket-level, so the conn must be dropped
+        # like any OSError.
+        if isinstance(e, PGError) and not e.code.startswith("08"):
             definite = (e.code in YSQL_DEFINITE_ABORT
                         or (_YSQL_FAIL_MSG.search(e.message)
                             and not _YSQL_INFO_MSG.search(e.message)))
@@ -607,10 +666,13 @@ class _YSQLClient(jclient.Client):
                         "error": ["sql", e.code, e.message]}
             return {**op, "type": "info",
                     "error": ["sql", e.code, e.message]}
+        self._drop_conn()
         return {**op, "type": "fail" if read_only else "info",
                 "error": ["conn", str(e)]}
 
     def _txn(self, stmts_fn, op, read_only=False):
+        if not self._ensure_conn():
+            return _reconn_fail(op)
         conn = self.conn
         try:
             conn.query("begin")
@@ -618,16 +680,24 @@ class _YSQLClient(jclient.Client):
             conn.query("commit")
             return {**op, "type": "ok", **out}
         except Exception as e:  # noqa: BLE001 — classified below
-            try:
-                conn.query("rollback")
-            except Exception:  # noqa: BLE001 — conn may be dead
-                pass
+            # Rolling back on a desynced socket would just stall for
+            # another timeout; _capture drops the conn for those.
+            socket_dead = (isinstance(e, (OSError, ConnectionError))
+                           or (isinstance(e, PGError)
+                               and e.code.startswith("08")))
+            if not socket_dead:
+                try:
+                    conn.query("rollback")
+                except Exception:  # noqa: BLE001 — conn is dead
+                    self._drop_conn()
             if isinstance(e, (PGError, OSError, ConnectionError)):
                 return self._capture(op, e, read_only)
             raise
 
     def _run(self, body_fn, op, read_only=False):
         """Single-statement op outside an explicit txn."""
+        if not self._ensure_conn():
+            return _reconn_fail(op)
         try:
             return {**op, "type": "ok", **body_fn(self.conn)}
         except (PGError, OSError, ConnectionError) as e:
@@ -993,6 +1063,8 @@ class YSQLDefaultValue(_YSQLClient):
 
     def invoke(self, test, op):
         f = op["f"]
+        if not self._ensure_conn():
+            return _reconn_fail(op)
         try:
             if f == "create-table":
                 self.conn.query(
