@@ -10,7 +10,7 @@ from jepsen_tpu.checker import synth
 from jepsen_tpu.checker.linear import analysis_host
 from jepsen_tpu.checker import wgl
 from jepsen_tpu.checker.wgl import (SlotOverflow, analysis_tpu,
-                                    analysis_tpu_batch, build_entries,
+                                    analysis_tpu_batch, build_steps,
                                     check_batch_sharded,
                                     encode_ops_for_model)
 from jepsen_tpu.history import History
@@ -183,7 +183,7 @@ def test_slot_overflow_detection():
         [op("invoke", "write", i, i) for i in range(10)])  # 10 pending
     ops = encode_ops_for_model(m.cas_register(), hist)
     with pytest.raises(SlotOverflow):
-        build_entries(ops, 4)
+        build_steps(ops, 4)
 
 
 def test_slot_overflow_escalates_transparently():
