@@ -1,0 +1,423 @@
+"""In-process FaunaDB fake: an HTTP server interpreting the JSON query
+AST from `jepsen_tpu.suites.fauna_query` over a *versioned* store —
+FaunaDB is a temporal database, so `at` reads past snapshots and
+`events` lists an instance's version history. Transactions (one POST =
+one txn) are serialized under a lock, evaluated sequentially so later
+expressions observe earlier writes (the property the internal workload
+probes), and rolled back wholesale on `abort`.
+
+Timestamps are zero-padded counters rendered as "<n>Z" so the suite's
+strip_time sorting works the same way it does on real RFC-3339 stamps.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Fault(Exception):
+    def __init__(self, status: int, code: str, description: str):
+        super().__init__(description)
+        self.status, self.code, self.description = status, code, description
+
+
+class Abort(Fault):
+    def __init__(self, msg: str):
+        super().__init__(400, "transaction aborted", msg)
+
+
+def _ts_str(n: int) -> str:
+    return f"{n:019d}Z"
+
+
+class DB:
+    """The versioned store + AST evaluator."""
+
+    def __init__(self):
+        self.classes: dict[str, dict[str, list]] = {}   # name->id->versions
+        self.indexes: dict[str, dict] = {}
+        self.ts = 0
+        self.auto_id = 0
+        self.lock = threading.Lock()
+        self.fail_hook = None   # expr -> None | (status, code, desc)
+
+    # -- transaction entry ---------------------------------------------------
+
+    def transact(self, expr):
+        with self.lock:
+            if self.fail_hook is not None:
+                f = self.fail_hook(expr)
+                if f is not None:
+                    raise Fault(*f)
+            self.ts += 1
+            txn = _Txn(self, self.ts)
+            try:
+                return txn.eval(expr, {}, None)
+            except BaseException:
+                txn.rollback()
+                raise
+
+
+class _Txn:
+    def __init__(self, db: DB, ts: int):
+        self.db = db
+        self.ts = ts
+        self.undo: list = []    # (class, id, prior version list copy)
+
+    def rollback(self):
+        for cls, id_, prior in reversed(self.undo):
+            self.db.classes[cls][id_] = prior
+
+    # -- instance store ------------------------------------------------------
+
+    def _versions(self, cls: str, id_: str) -> list:
+        return self.db.classes.setdefault(cls, {}).setdefault(id_, [])
+
+    def _live(self, cls: str, id_: str, at: int | None):
+        at = self.ts if at is None else at
+        data = None
+        ts = None
+        # read path: never create class/instance entries
+        for (vts, vdata) in self.db.classes.get(cls, {}).get(id_, ()):
+            if vts > at:
+                break
+            data, ts = vdata, vts
+        return (ts, data) if data is not None else None
+
+    def _write(self, cls: str, id_: str, data):
+        vs = self._versions(cls, id_)
+        self.undo.append((cls, id_, list(vs)))
+        vs.append((self.ts, data))
+
+    def _instance(self, cls: str, id_: str, ts: int, data) -> dict:
+        return {"ref": {"class": cls, "id": id_}, "ts": _ts_str(ts),
+                "data": data}
+
+    # -- index reads ---------------------------------------------------------
+
+    @staticmethod
+    def _field(data: dict, path: list):
+        cur = {"data": data}
+        for p in path:
+            if p == "ref":
+                return "ref"
+            if not isinstance(cur, dict) or p not in cur:
+                return None
+            cur = cur[p]
+        return cur
+
+    def _match(self, idx: dict, term, at: int | None) -> list:
+        src = idx["source"]
+        if isinstance(src, dict):
+            src = src["class"]
+        rows = []
+        for id_, _vs in list(self.db.classes.get(src, {}).items()):
+            live = self._live(src, id_, at)
+            if live is None:
+                continue
+            ts, data = live
+            if idx.get("terms"):
+                tvals = [self._field(data, t["field"])
+                         for t in idx["terms"]]
+                if tvals != [term]:
+                    continue
+            vals = []
+            for v in idx.get("values", []):
+                if v["field"] == ["ref"]:
+                    vals.append({"class": src, "id": id_})
+                else:
+                    vals.append(self._field(data, v["field"]))
+            if not vals:
+                row = {"class": src, "id": id_}
+            elif len(vals) == 1:
+                row = vals[0]
+            else:
+                row = vals
+            rows.append(row)
+
+        def key(r):
+            return json.dumps(r, sort_keys=True, default=str)
+        rows.sort(key=key)
+        return rows
+
+    # -- evaluator -----------------------------------------------------------
+
+    def eval(self, e, env: dict, at: int | None):
+        ev = lambda x: self.eval(x, env, at)  # noqa: E731
+        if e is None or isinstance(e, (bool, int, float, str)):
+            return e
+        if isinstance(e, list):
+            return [ev(x) for x in e]
+        assert isinstance(e, dict), e
+
+        if "object" in e and len(e) == 1:
+            return {k: ev(v) for k, v in e["object"].items()}
+        if "var" in e and len(e) == 1:
+            return env[e["var"]]
+        if "let" in e:
+            env = dict(env)
+            for binding in e["let"]:
+                (k, v), = binding.items()
+                env[k] = self.eval(v, env, at)
+            return self.eval(e["in"], env, at)
+        if "if" in e:
+            return ev(e["then"]) if ev(e["if"]) else ev(e["else"])
+        if "do" in e:
+            out = None
+            for x in e["do"]:
+                out = ev(x)
+            return out
+        if "lambda" in e:
+            return e     # a function value; applied by map/foreach
+        if "map" in e:
+            coll = ev(e["collection"])
+            items = coll["data"] if isinstance(coll, dict) else coll
+            fn = e["map"]
+            out = []
+            for item in items:
+                args = item if isinstance(item, list) else [item]
+                env2 = dict(env)
+                for p, a in zip(fn["lambda"], args):
+                    env2[p] = a
+                out.append(self.eval(fn["expr"], env2, at))
+            if isinstance(coll, dict):
+                return {**coll, "data": out}
+            return out
+        if "foreach" in e:
+            self.eval({"map": e["foreach"],
+                       "collection": e["collection"]}, env, at)
+            return ev(e["collection"])
+        if "time" in e:
+            assert e["time"] == "now", e
+            return _ts_str(self.ts)
+        if "at" in e:
+            ts_s = ev(e["at"])
+            at2 = int(str(ts_s).rstrip("Z"))
+            return self.eval(e["expr"], env, at2)
+        if "abort" in e:
+            raise Abort(ev(e["abort"]))
+        if "add" in e:
+            vals = [ev(x) for x in e["add"]]
+            return sum(vals)
+        if "subtract" in e:
+            vals = [ev(x) for x in e["subtract"]]
+            out = vals[0]
+            for v in vals[1:]:
+                out -= v
+            return out
+        if "lt" in e:
+            vals = [ev(x) for x in e["lt"]]
+            return all(a < b for a, b in zip(vals, vals[1:]))
+        if "equals" in e:
+            vals = [ev(x) for x in e["equals"]]
+            return all(v == vals[0] for v in vals[1:])
+        if "not" in e:
+            return not ev(e["not"])
+        if "and" in e:
+            return all(ev(x) for x in e["and"])
+        if "or" in e:
+            return any(ev(x) for x in e["or"])
+        if "non_empty" in e:
+            v = ev(e["non_empty"])
+            if isinstance(v, dict):
+                v = v.get("data")
+            return bool(v)
+        if "select" in e:
+            return self._select(e, env, at)
+        if "exists" in e:
+            return self._exists(ev(e["exists"]), at)
+        if "get" in e:
+            return self._get(ev(e["get"]), at)
+        if "create" in e:
+            return self._create(ev(e["create"]), ev(e["params"]))
+        if "update" in e:
+            return self._update(ev(e["update"]), ev(e["params"]))
+        if "delete" in e:
+            return self._delete(ev(e["delete"]))
+        if "create_class" in e:
+            params = ev(e["create_class"])
+            self.db.classes.setdefault(params["name"], {})
+            return {"class": params["name"]}
+        if "create_index" in e:
+            params = ev(e["create_index"])
+            self.db.indexes[params["name"]] = params
+            return {"index": params["name"]}
+        if "match" in e:
+            return {"@match": ev(e["match"]),
+                    "@term": ev(e.get("terms")) if "terms" in e else None}
+        if "events" in e:
+            r = ev(e["events"])
+            return {"@events": r}
+        if "paginate" in e:
+            return self._paginate(e, env, at)
+        if "class" in e and len(e) == 1:
+            return e
+        if "index" in e and len(e) == 1:
+            return e
+        if "ref" in e:
+            return {"ref": ev(e["ref"]), "id": str(ev(e["id"]))}
+        raise Fault(400, "invalid expression", f"unhandled form {e!r}")
+
+    # -- form implementations ------------------------------------------------
+
+    def _select(self, e, env, at):
+        cur = self.eval(e["from"], env, at)
+        for p in e["select"]:
+            p = self.eval(p, env, at) if isinstance(p, dict) else p
+            if isinstance(cur, list) and isinstance(p, int):
+                if not 0 <= p < len(cur):
+                    return self._default(e, env, at)
+                cur = cur[p]
+            elif isinstance(cur, dict) and p in cur:
+                cur = cur[p]
+            else:
+                return self._default(e, env, at)
+        return cur
+
+    def _default(self, e, env, at):
+        if "default" in e:
+            return self.eval(e["default"], env, at)
+        raise Fault(404, "value not found", "path not found in select")
+
+    def _exists(self, r, at) -> bool:
+        if "index" in r:
+            return r["index"] in self.db.indexes
+        if "class" in r and "id" not in r:
+            return r["class"] in self.db.classes
+        cls, id_ = r["ref"]["class"], r["id"]
+        return self._live(cls, id_, at) is not None
+
+    def _get(self, r, at):
+        if "index" in r:
+            idx = self.db.indexes.get(r["index"])
+            if idx is None:
+                raise Fault(404, "instance not found", "no such index")
+            return idx
+        cls, id_ = r["ref"]["class"], r["id"]
+        live = self._live(cls, id_, at)
+        if live is None:
+            raise Fault(404, "instance not found",
+                        f"no instance {cls}/{id_}")
+        ts, data = live
+        return self._instance(cls, id_, ts, data)
+
+    def _create(self, target, params):
+        data = params.get("data", {})
+        if "class" in target and "ref" not in target:
+            cls = target["class"]
+            self.db.auto_id += 1
+            id_ = str(10**9 + self.db.auto_id)
+        else:
+            cls, id_ = target["ref"]["class"], target["id"]
+            if self._live(cls, id_, None) is not None:
+                raise Fault(400, "instance already exists",
+                            f"{cls}/{id_} exists")
+        if cls not in self.db.classes:
+            raise Fault(400, "invalid ref", f"no class {cls}")
+        self._write(cls, id_, data)
+        return self._instance(cls, id_, self.ts, data)
+
+    def _update(self, r, params):
+        cls, id_ = r["ref"]["class"], r["id"]
+        live = self._live(cls, id_, None)
+        if live is None:
+            raise Fault(404, "instance not found",
+                        f"no instance {cls}/{id_}")
+        _, data = live
+        # versions store the instance's data map; update merges fields
+        new = {**data, **params.get("data", {})}
+        self._write(cls, id_, new)
+        return self._instance(cls, id_, self.ts, new)
+
+    def _delete(self, r):
+        cls, id_ = r["ref"]["class"], r["id"]
+        live = self._live(cls, id_, None)
+        if live is None:
+            raise Fault(404, "instance not found",
+                        f"no instance {cls}/{id_}")
+        self._write(cls, id_, None)
+        return self._instance(cls, id_, self.ts, live[1])
+
+    def _paginate(self, e, env, at):
+        src = self.eval(e["paginate"], env, at)
+        size = e.get("size", 64)
+        after = e.get("after")
+        if isinstance(after, dict):
+            after = self.eval(after, env, at)
+        if isinstance(src, dict) and "@match" in src:
+            idx = self.db.indexes.get(src["@match"].get("index"))
+            if idx is None:
+                raise Fault(404, "instance not found", "no such index")
+            rows = self._match(idx, src["@term"], at)
+        elif isinstance(src, dict) and "@events" in src:
+            r = src["@events"]
+            cls, id_ = r["ref"]["class"], r["id"]
+            rows = []
+            prev = None
+            for (vts, vdata) in self.db.classes.get(cls, {}).get(id_, ()):
+                if vts > (self.ts if at is None else at):
+                    break
+                action = "delete" if vdata is None else \
+                    ("create" if prev is None else "update")
+                rows.append({"ts": _ts_str(vts), "action": action,
+                             "data": vdata})
+                prev = vdata
+            return {"data": rows[:size]}
+        else:
+            rows = src if isinstance(src, list) else [src]
+        start = int(after) if after is not None else 0
+        page = rows[start:start + size]
+        out = {"data": page}
+        if start + size < len(rows):
+            out["after"] = start + size
+        return out
+
+
+class FakeFauna:
+    """HTTP wrapper; starts on a random port."""
+
+    def __init__(self):
+        self.db = DB()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    expr = json.loads(self.rfile.read(n))
+                    res = fake.db.transact(expr)
+                    body = json.dumps({"resource": res},
+                                      default=str).encode()
+                    status = 200
+                except Fault as f:
+                    body = json.dumps({"errors": [{
+                        "code": f.code,
+                        "description": f.description}]}).encode()
+                    status = f.status
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def fail_hook(self):
+        return self.db.fail_hook
+
+    @fail_hook.setter
+    def fail_hook(self, f):
+        self.db.fail_hook = f
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

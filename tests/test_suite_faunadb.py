@@ -1,0 +1,370 @@
+"""FaunaDB suite tests: the query AST + wire client against the
+in-process fake (real HTTP, versioned temporal store), error
+classification, checker units, topology state machine, and hermetic
+end-to-end runs for register, g2, monotonic, pages, bank, set,
+internal, multimonotonic, and a topology-nemesis run."""
+
+import pytest
+
+from fake_fauna import FakeFauna
+
+import jepsen_tpu.db as jdb
+import jepsen_tpu.os_ as jos
+from jepsen_tpu import core, independent
+from jepsen_tpu.suites import faunadb as fdb
+from jepsen_tpu.suites import fauna_query as q
+from jepsen_tpu.suites.faunadb import (FaunaConn, FaunaError, Incomparable,
+                                       map_compare, pages_read_errs,
+                                       with_errors)
+
+
+@pytest.fixture
+def fake():
+    f = FakeFauna()
+    yield f
+    f.stop()
+
+
+def conn_fn(fake):
+    return lambda node, linearized=False: FaunaConn(
+        "127.0.0.1", fake.port, linearized=linearized, timeout_s=5.0)
+
+
+# -- wire client + AST -------------------------------------------------------
+
+def test_query_roundtrip(fake):
+    c = FaunaConn("127.0.0.1", fake.port)
+    c.query(q.create_class({"name": "things"}))
+    r = q.ref("things", 1)
+    res = c.query(q.create(r, {"data": {"x": 41}}))
+    assert res["data"] == {"x": 41}
+    assert c.query(q.exists(r)) is True
+    res = c.query(q.update(r, {"data": {"x": 42}}))
+    assert res["data"]["x"] == 42
+    assert c.query(q.select(["data", "x"], q.get(r))) == 42
+    # let / arithmetic / comparison forms
+    assert c.query(q.let({"a": 40}, q.add(q.var("a"), 2))) == 42
+    assert c.query(q.lt(1, 2, 3)) is True
+    assert c.query(q.if_(q.eq(1, 2), "y", "n")) == "n"
+    c.close()
+
+
+def test_temporal_at_reads_past_snapshot(fake):
+    """FaunaDB is temporal: at-queries see the store as of a past ts."""
+    c = FaunaConn("127.0.0.1", fake.port)
+    c.query(q.create_class({"name": "reg"}))
+    r = q.ref("reg", 0)
+    c.query(q.create(r, {"data": {"v": 1}}))
+    ts1 = c.query(q.NOW)
+    c.query(q.update(r, {"data": {"v": 2}}))
+    now_v = c.query(q.select(["data", "v"], q.get(r)))
+    past_v = c.query(q.at(ts1, q.select(["data", "v"], q.get(r))))
+    assert (now_v, past_v) == (2, 1)
+    # and events lists the version history
+    evs = c.query(q.paginate(q.events(r), size=10))["data"]
+    assert [e["action"] for e in evs] == ["create", "update"]
+    c.close()
+
+
+def test_abort_rolls_back(fake):
+    c = FaunaConn("127.0.0.1", fake.port)
+    c.query(q.create_class({"name": "t"}))
+    r = q.ref("t", 1)
+    with pytest.raises(FaunaError) as ei:
+        c.query(q.do(q.create(r, {"data": {"x": 1}}),
+                     q.abort("nope")))
+    assert "nope" in ei.value.description
+    assert c.query(q.exists(r)) is False  # create was rolled back
+    c.close()
+
+
+def test_index_match_and_pagination(fake):
+    c = FaunaConn("127.0.0.1", fake.port)
+    c.query(q.create_class({"name": "el"}))
+    c.query(q.create_index({"name": "all", "source": q.class_("el"),
+                            "active": True,
+                            "values": [{"field": ["data", "v"]}]}))
+    for v in range(10):
+        c.query(q.create(q.ref("el", v), {"data": {"v": v}}))
+    rows = fdb.query_all(c, q.match(q.index("all")), size=3)
+    assert rows == list(range(10))
+    c.close()
+
+
+def test_error_classification(fake):
+    """with-errors taxonomy (`client.clj:375-418`)."""
+    op = {"f": "read", "process": 0}
+    wop = {"f": "write", "process": 0}
+    fake.fail_hook = lambda e: (503, "unavailable", "replica down")
+    c = FaunaConn("127.0.0.1", fake.port)
+    r = with_errors(op, frozenset({"read"}),
+                    lambda: c.query(q.NOW), pause_s=0)
+    assert r["type"] == "fail" and r["error"][0] == "unavailable"
+    r = with_errors(wop, frozenset({"read"}),
+                    lambda: c.query(q.NOW), pause_s=0)
+    assert r["type"] == "info"
+    fake.fail_hook = lambda e: (500, "internal server error",
+                                "fauna.repo.UninitializedException: x")
+    r = with_errors(wop, frozenset(),
+                    lambda: c.query(q.NOW), pause_s=0)
+    assert r == {**wop, "type": "fail", "error": "repo-uninitialized"}
+    fake.fail_hook = lambda e: (500, "internal server error",
+                                "Transaction Coordinator is shut down")
+    r = with_errors(wop, frozenset(),
+                    lambda: c.query(q.NOW), pause_s=0)
+    assert r["error"] == "transaction-coordinator-shut-down"
+    fake.fail_hook = None
+    c.close()
+
+
+def test_connection_refused_classified_as_fail():
+    op = {"f": "write", "process": 0}
+
+    def boom():
+        c = FaunaConn("127.0.0.1", 1, timeout_s=0.2)  # nothing listens
+        return c.query(q.NOW)
+    r = with_errors(op, frozenset(), boom, pause_s=0)
+    assert r["type"] == "fail"
+    assert r["error"] in ("connection-refused",) or \
+        r["error"][0] == "connect"
+
+
+# -- checker units -----------------------------------------------------------
+
+def test_pages_read_errs():
+    idx = {1: frozenset({1, 2}), 2: frozenset({1, 2}),
+           3: frozenset({3, 4}), 4: frozenset({3, 4})}
+    assert pages_read_errs(idx, {1, 2, 3, 4}) == []
+    errs = pages_read_errs(idx, {1, 3, 4})
+    assert errs and errs[0]["expected"] == [1, 2]
+    assert pages_read_errs(idx, set()) == []
+
+
+def test_map_compare():
+    assert map_compare({"x": 1}, {"x": 2}) == -1
+    assert map_compare({"x": 2, "y": 5}, {"x": 1}) == 1
+    assert map_compare({"x": 1}, {"y": 9}) == 0
+    with pytest.raises(Incomparable):
+        map_compare({"x": 1, "y": 2}, {"x": 2, "y": 1})
+
+
+def test_read_skew_checker_detects_cycle():
+    hist = [
+        {"type": "ok", "f": "read", "process": 0,
+         "value": {"ts": "1", "registers": {
+             "x": {"value": 1}, "y": {"value": 2}}}},
+        {"type": "ok", "f": "read", "process": 1,
+         "value": {"ts": "2", "registers": {
+             "x": {"value": 2}, "y": {"value": 1}}}},
+    ]
+    res = fdb.ReadSkewChecker().check({}, hist, {})
+    assert res["valid?"] is False and res["cycles"]
+    ok = [
+        {"type": "ok", "f": "read", "process": 0,
+         "value": {"ts": "1", "registers": {
+             "x": {"value": 1}, "y": {"value": 1}}}},
+        {"type": "ok", "f": "read", "process": 1,
+         "value": {"ts": "2", "registers": {
+             "x": {"value": 2}, "y": {"value": 2}}}},
+    ]
+    assert fdb.ReadSkewChecker().check({}, ok, {})["valid?"] is True
+
+
+def test_ts_order_checker():
+    hist = [
+        {"type": "ok", "f": "read", "index": 0,
+         "value": {"ts": "1", "registers": {"x": {"value": 5}}}},
+        {"type": "ok", "f": "read", "index": 1,
+         "value": {"ts": "2", "registers": {"x": {"value": 3}}}},
+    ]
+    res = fdb.TsOrderChecker().check({}, hist, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["errors"]["x"][0]["value"] == 5
+
+
+def test_monotonic_checker():
+    hist = [
+        {"type": "ok", "f": "read", "process": 3, "value": ["1", 4]},
+        {"type": "ok", "f": "read", "process": 3, "value": ["2", 3]},
+    ]
+    res = fdb.MonotonicChecker().check({}, hist, {})
+    assert res["valid?"] is False and res["value-errors"]
+
+
+def test_internal_op_errors():
+    ok_op = {"type": "ok", "f": "create-tabby-arr",
+             "value": {"tabbies-0": [], "tabby": {"data": {"name": 7}},
+                       "tabbies-1": [7]}}
+    assert fdb.internal_op_errors(ok_op) == []
+    bad = {"type": "ok", "f": "create-tabby-arr",
+           "value": {"tabbies-0": [7], "tabby": {"data": {"name": 7}},
+                     "tabbies-1": []}}
+    errs = fdb.internal_op_errors(bad)
+    assert {e["type"] for e in errs} == {"present-before-create",
+                                        "missing-after-create"}
+
+
+# -- topology ---------------------------------------------------------------
+
+def test_topology_state_machine():
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"], "replicas": 2}
+    topo = fdb.initial_topology(test)
+    assert topo["replica-count"] == 2
+    by_rep = fdb.nodes_by_replica(topo)
+    assert sorted(by_rep) == ["replica-0", "replica-1"]
+    # full cluster: only removes possible
+    assert fdb.add_ops(test, topo) == []
+    removes = fdb.remove_ops(test, topo)
+    assert {o["f"] for o in removes} == {"remove-node"}
+    # apply a removal, then adding it back becomes possible
+    op = removes[0]
+    topo2 = fdb.apply_topo_op(topo, op)
+    assert fdb.get_node(topo2, op["value"])["state"] == "removing"
+    topo3 = {**topo2, "nodes": [n for n in topo2["nodes"]
+                                if n["node"] != op["value"]]}
+    adds = fdb.add_ops(test, topo3)
+    assert [o["value"]["node"] for o in adds] == [op["value"]]
+    topo4 = fdb.apply_topo_op(topo3, adds[0])
+    assert fdb.get_node(topo4, op["value"])["state"] == "active"
+
+
+def test_all_combos_and_workload_options():
+    combos = fdb.all_combos({"a": [1, 2], "b": [True, False]})
+    assert len(combos) == 4
+    allw = fdb.all_workload_options(fdb.WORKLOAD_OPTIONS)
+    assert {"workload": "register"} in allw
+    assert len(allw) > 20
+
+
+# -- hermetic end-to-end runs ------------------------------------------------
+
+def _run(fake, tmp_path, workload, time_limit=3, nemesis=(), **opts):
+    t = fdb.faunadb_test({
+        "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+        "ssh": {"dummy": True}, "workload": workload,
+        "rate": 200, "time-limit": time_limit,
+        "nemesis": list(nemesis),
+        "store-dir": str(tmp_path),
+        "fauna-conn-fn": conn_fn(fake),
+        "fauna-conn-retry-delay": 0.0,
+        **opts})
+    t["db"] = jdb.noop
+    t["os"] = jos.noop
+    return core.run(t)
+
+
+def test_e2e_register(fake, tmp_path):
+    done = _run(fake, tmp_path, "register",
+                **{"ops-per-key": 30, "register-stagger": 0.005,
+                   "register-delay": 0.0})
+    assert done["results"]["valid?"] is True
+    assert len(done["history"]) > 20
+    # linearizable sub-result present per key
+    wl = done["results"]["workload"]
+    assert wl["valid?"] is True
+
+
+def test_e2e_g2(fake, tmp_path):
+    done = _run(fake, tmp_path, "g2")
+    assert done["results"]["valid?"] is True
+    wl = done["results"]["workload"]
+    assert wl["key-count"] > 0
+
+
+def test_e2e_monotonic(fake, tmp_path):
+    """Exercises the at-query-jitter path: read-at ops query a
+    jittered past timestamp (the fake's counter timestamps get a
+    counter-space jitter fn)."""
+    import random as _random
+
+    def jitter(ts, jitter_ms):
+        n = int(ts.rstrip("Z"))
+        return f"{max(1, n - _random.randrange(3)):019d}Z"
+
+    done = _run(fake, tmp_path, "monotonic",
+                **{"at-query-jitter": 10_000,
+                   "fauna-jitter-time-fn": jitter})
+    assert done["results"]["valid?"] is True
+    incs = [o for o in done["history"]
+            if o.get("f") == "inc" and o.get("type") == "ok"]
+    assert incs, "monotonic run must land increments"
+    read_ats = [o for o in done["history"]
+                if o.get("f") == "read-at" and o.get("type") == "ok"]
+    assert read_ats, "read-at ops must land"
+
+
+def test_e2e_pages(fake, tmp_path):
+    done = _run(fake, tmp_path, "pages",
+                **{"pages-elements": 40, "ops-per-key": 30})
+    assert done["results"]["valid?"] is True
+    assert done["results"]["workload"]["valid?"] is True
+
+
+def test_e2e_bank(fake, tmp_path):
+    done = _run(fake, tmp_path, "bank", **{"bank-delay": 0.005})
+    assert done["results"]["valid?"] is True
+    reads = [o for o in done["history"]
+             if o.get("f") == "read" and o.get("type") == "ok"]
+    assert reads and all(sum(r["value"].values()) == 100 for r in reads)
+
+
+def test_e2e_bank_index(fake, tmp_path):
+    done = _run(fake, tmp_path, "bank-index",
+                **{"serialized-indices": True, "bank-delay": 0.005})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_set_strong_read(fake, tmp_path):
+    done = _run(fake, tmp_path, "set",
+                **{"strong-read": True, "serialized-indices": True})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_internal(fake, tmp_path):
+    done = _run(fake, tmp_path, "internal",
+                **{"serialized-indices": True})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_multimonotonic(fake, tmp_path):
+    done = _run(fake, tmp_path, "multimonotonic")
+    assert done["results"]["valid?"] is True
+    reads = [o for o in done["history"]
+             if o.get("f") == "read" and o.get("type") == "ok"]
+    assert reads
+
+
+def test_e2e_register_with_topology_nemesis(fake, tmp_path):
+    """Topology churn over the dummy remote: transitions execute, the
+    topology map stays consistent, and the workload still verifies."""
+    done = _run(fake, tmp_path, "register", time_limit=4,
+                nemesis=("topology",),
+                **{"ops-per-key": 30, "nemesis-interval": 0.5,
+                   "replicas": 1, "register-stagger": 0.005,
+                   "register-delay": 0.0})
+    assert done["results"]["valid?"] is True
+    topo_ops = [o for o in done["history"]
+                if o.get("f") in ("add-node", "remove-node")]
+    assert topo_ops, "topology nemesis must act"
+    topo = done["topology"]["value"]
+    names = [n["node"] for n in topo["nodes"]]
+    assert len(names) == len(set(names))
+
+
+def test_e2e_register_with_partition_nemesis(fake, tmp_path):
+    done = _run(fake, tmp_path, "register", time_limit=4,
+                nemesis=("single-node-partition",),
+                **{"ops-per-key": 30, "nemesis-interval": 0.5,
+                   "register-stagger": 0.005, "register-delay": 0.0})
+    assert done["results"]["valid?"] is True
+    parts = [o for o in done["history"]
+             if o.get("f") == "start-partition"]
+    assert parts, "partition nemesis must act"
+
+
+def test_workload_menu_registered():
+    from jepsen_tpu.suites import suite
+    mod = suite("faunadb")
+    assert set(mod.WORKLOADS) == {
+        "register", "bank", "bank-index", "g2", "set", "pages",
+        "monotonic", "multimonotonic", "internal"}
