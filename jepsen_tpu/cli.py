@@ -184,6 +184,14 @@ def test_opt_fn(opts: dict) -> dict:
         opts["test-count"] = opts.pop("test_count")
     parse_nodes(opts)
     parse_concurrency(opts)
+    # argparse stores --some-flag as some_flag; test maps use the
+    # hyphenated spelling throughout (a test *is* a map, keyed like the
+    # reference's :some-flag keywords) — rename every remaining
+    # underscore key so suite opt-specs can't silently miss
+    for k in [k for k in opts if isinstance(k, str) and "_" in k]:
+        hy = k.replace("_", "-")
+        if hy not in opts:
+            opts[hy] = opts.pop(k)
     return opts
 
 

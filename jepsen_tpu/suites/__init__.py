@@ -16,7 +16,7 @@ SUITES = ("etcd", "zookeeper", "hazelcast", "consul", "tidb",
           "stolon", "postgres_rds", "raftis", "mongodb", "aerospike",
           "mongodb_smartos", "logcabin", "robustirc",
           "mysql_cluster", "rethinkdb", "elasticsearch", "crate",
-          "ignite", "chronos", "yugabyte", "faunadb")
+          "ignite", "chronos", "yugabyte", "faunadb", "dgraph")
 
 
 def suite(name: str):

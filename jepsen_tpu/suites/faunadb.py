@@ -119,10 +119,30 @@ class FaunaConn:
                     errors="replace")}
             raise FaunaError(resp.status, err.get("code", "unknown"),
                              err.get("description", ""))
-        return json.loads(data)["resource"]
+        return _decode(json.loads(data)["resource"])
 
     def close(self):
         self._http.close()
+
+
+def _decode(v):
+    """Unwrap FaunaDB wire-format special values — {"@ts": ...}
+    timestamps, {"@ref": ...} refs, {"@obj": ...} escaped objects —
+    the decoding the reference gets from the JVM driver's Value tree
+    (`client.clj:115-141`). Plain JSON (and the test fake's output)
+    passes through unchanged."""
+    if isinstance(v, dict):
+        if len(v) == 1:
+            if "@ts" in v:
+                return v["@ts"]
+            if "@ref" in v:
+                return _decode(v["@ref"])
+            if "@obj" in v:
+                return _decode(v["@obj"])
+        return {k: _decode(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode(x) for x in v]
+    return v
 
 
 def connect(test: dict, node: str, linearized: bool = False) -> FaunaConn:

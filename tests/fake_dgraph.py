@@ -1,0 +1,312 @@
+"""In-process dgraph fake: an HTTP server implementing the alpha's
+transactional HTTP API (/alter, /query, /mutate, /commit) over a
+versioned triple store with snapshot-isolation semantics:
+
+  * every transaction reads at its start-ts snapshot,
+  * writes are buffered server-side per start-ts,
+  * /commit detects write-write conflicts ((uid, pred) keys, plus
+    (pred, value) index keys for @upsert predicates) against
+    transactions committed after start-ts, answering with dgraph's
+    "Transaction has been aborted. Please retry." message,
+
+plus a zero /state + /moveTablet surface for the tablet-mover nemesis.
+Queries parse exactly the graphql+- shapes the suite client emits:
+``{ q(func: eq(pred, $var)) { fields } }`` and ``func: uid($u)``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+ABORTED_MSG = "Transaction has been aborted. Please retry"
+
+_QUERY_RE = re.compile(
+    r"\{\s*(?P<block>\w+)\s*\(\s*func:\s*(?P<fn>eq|uid)\s*\(\s*"
+    r"(?P<arg1>[\w\-\$]+)\s*(?:,\s*(?P<arg2>[^)]+))?\)\s*\)\s*"
+    r"\{(?P<fields>[^}]*)\}\s*\}")
+
+
+class FakeDgraph:
+    def __init__(self):
+        self.schema: dict[str, dict] = {}   # pred -> {index, upsert, type}
+        # uid -> list of (ts, {pred: value} | None)
+        self.nodes: dict[str, list] = {}
+        self.ts = 0
+        self.next_uid = 0
+        # start_ts -> {"writes": [(uid, {pred: val|None})], "ckeys": set}
+        self.txns: dict[int, dict] = {}
+        self.commit_log: list[tuple[int, frozenset]] = []  # (commit_ts, ckeys)
+        self.lock = threading.Lock()
+        self.fail_hook = None   # (path, body) -> None | message str
+        self.moves: list = []
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, doc, status=200):
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                u = urlparse(self.path)
+                if u.path == "/state":
+                    self._reply(fake.state())
+                elif u.path == "/moveTablet":
+                    qs = parse_qs(u.query)
+                    fake.moves.append((qs.get("tablet", [""])[0],
+                                      qs.get("group", [""])[0]))
+                    self._reply({"data": "ok"})
+                else:
+                    self._reply({"errors": [{"message": "not found"}]},
+                                404)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                u = urlparse(self.path)
+                qs = parse_qs(u.query)
+                start_ts = int(qs["startTs"][0]) if "startTs" in qs \
+                    else None
+                try:
+                    body = json.loads(raw) if raw else {}
+                    hook = fake.fail_hook
+                    if hook is not None:
+                        msg = hook(u.path, body)
+                        if msg is not None:
+                            raise Abort(msg)
+                    if u.path == "/alter":
+                        doc = fake.alter(body)
+                    elif u.path == "/query":
+                        doc = fake.query(start_ts, body)
+                    elif u.path == "/mutate":
+                        doc = fake.mutate(start_ts, body)
+                    elif u.path == "/commit":
+                        doc = fake.commit(start_ts, body,
+                                          abort="abort" in qs)
+                    else:
+                        raise Abort(f"unknown path {u.path}")
+                    self._reply(doc)
+                except Abort as e:
+                    self._reply({"errors": [{"message": str(e)}]}, 409)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- store ---------------------------------------------------------------
+
+    def _snapshot(self, uid: str, at: int) -> dict | None:
+        out = None
+        for ts, data in self.nodes.get(uid, ()):
+            if ts > at:
+                break
+            out = data
+        return out
+
+    def _live_uids(self, at: int):
+        for uid in list(self.nodes):
+            data = self._snapshot(uid, at)
+            if data is not None:
+                yield uid, data
+
+    # -- API ----------------------------------------------------------------
+
+    def alter(self, body: dict) -> dict:
+        with self.lock:
+            for line in (body.get("schema") or "").splitlines():
+                line = line.strip().rstrip(".").strip()
+                if not line:
+                    continue
+                m = re.match(r"([\w\-]+):\s*(\S+)(.*)", line)
+                if not m:
+                    raise Abort(f"bad schema line {line!r}")
+                pred, typ, rest = m.groups()
+                self.schema[pred] = {
+                    "type": typ,
+                    "index": "@index" in rest,
+                    "upsert": "@upsert" in rest}
+            return {"data": {"code": "Success"}}
+
+    def _txn(self, start_ts: int | None):
+        if start_ts is None or start_ts == 0:
+            self.ts += 1
+            start_ts = self.ts
+        t = self.txns.setdefault(start_ts,
+                                 {"writes": [], "ckeys": set()})
+        return start_ts, t
+
+    def _ext(self, start_ts: int) -> dict:
+        return {"txn": {"start_ts": start_ts,
+                        "keys": [], "preds": []}}
+
+    def query(self, start_ts, body: dict) -> dict:
+        with self.lock:
+            start_ts, txn = self._txn(start_ts)
+            q = body.get("query") or ""
+            vars_ = {k.lstrip("$"): v
+                     for k, v in (body.get("vars") or {}).items()}
+            if re.search(r"schema\s*\{", q):
+                return {"data": {"schema": self.schema},
+                        "extensions": self._ext(start_ts)}
+            m = _QUERY_RE.search(q)
+            if not m:
+                raise Abort(f"unparseable query {q!r}")
+            fields = [f for f in m.group("fields").split() if f]
+            rows = []
+            # overlay this txn's own writes on the snapshot
+            overlay: dict[str, dict] = {}
+            for uid, delta in txn["writes"]:
+                cur = overlay.get(uid)
+                if cur is None:
+                    cur = dict(self._snapshot(uid, start_ts) or {})
+                if delta is None:
+                    cur = {}
+                else:
+                    for p, v in delta.items():
+                        if v is None:
+                            cur.pop(p, None)
+                        else:
+                            cur[p] = v
+                overlay[uid] = cur
+
+            def visible():
+                seen = set(overlay)
+                for uid, data in overlay.items():
+                    if data:
+                        yield uid, data
+                for uid, data in self._live_uids(start_ts):
+                    if uid not in seen:
+                        yield uid, data
+
+            if m.group("fn") == "uid":
+                var = m.group("arg1").lstrip("$")
+                target = vars_.get(var, m.group("arg1"))
+                data = None
+                if target in overlay:
+                    data = overlay[target] or None
+                else:
+                    data = self._snapshot(target, start_ts)
+                if data is not None:
+                    rows.append((target, data))
+            else:
+                pred = m.group("arg1")
+                arg2 = (m.group("arg2") or "").strip()
+                var = arg2.lstrip("$")
+                raw = vars_.get(var, arg2.strip('"'))
+                sch = self.schema.get(pred)
+                if sch is None or not sch["index"]:
+                    raise Abort(f"Attribute {pred} not indexed")
+                want = str(raw)
+                for uid, data in visible():
+                    if pred in data and str(data[pred]) == want:
+                        rows.append((uid, data))
+            out = []
+            for uid, data in sorted(rows):
+                row = {}
+                for f in fields:
+                    if f == "uid":
+                        row["uid"] = uid
+                    elif f in data:
+                        row[f] = data[f]
+                out.append(row)
+            block = m.group("block")
+            return {"data": {block: out},
+                    "extensions": self._ext(start_ts)}
+
+    def mutate(self, start_ts, body: dict) -> dict:
+        with self.lock:
+            start_ts, txn = self._txn(start_ts)
+            uids_out = {}
+            for obj in body.get("set") or []:
+                obj = dict(obj)
+                uid = obj.pop("uid", None)
+                if uid is None:
+                    self.next_uid += 1
+                    uid = f"0x{self.next_uid:x}"
+                    uids_out[f"blank-{len(uids_out)}"] = uid
+                txn["writes"].append((uid, obj))
+                for p, v in obj.items():
+                    txn["ckeys"].add((uid, p))
+                    sch = self.schema.get(p)
+                    if sch and sch["upsert"]:
+                        txn["ckeys"].add((p, str(v)))
+            for obj in body.get("delete") or []:
+                obj = dict(obj)
+                uid = obj.pop("uid", None)
+                if uid is None:
+                    raise Abort("delete requires uid")
+                if obj:
+                    delta = {p: None for p in obj}
+                    txn["writes"].append((uid, delta))
+                    for p in obj:
+                        txn["ckeys"].add((uid, p))
+                else:
+                    txn["writes"].append((uid, None))
+                    data = self._snapshot(uid, start_ts) or {}
+                    for p in data:
+                        txn["ckeys"].add((uid, p))
+            return {"data": {"uids": uids_out},
+                    "extensions": self._ext(start_ts)}
+
+    def commit(self, start_ts, body: dict, abort: bool = False) -> dict:
+        with self.lock:
+            txn = self.txns.pop(start_ts, None)
+            if abort or txn is None:
+                return {"data": {"code": "Done"}}
+            ckeys = frozenset(txn["ckeys"])
+            for commit_ts, other in self.commit_log:
+                if commit_ts > start_ts and ckeys & other:
+                    raise Abort(ABORTED_MSG)
+            self.ts += 1
+            commit_ts = self.ts
+            for uid, delta in txn["writes"]:
+                vs = self.nodes.setdefault(uid, [])
+                cur = dict(self._snapshot(uid, commit_ts) or {})
+                if delta is None:
+                    vs.append((commit_ts, None))
+                    continue
+                for p, v in delta.items():
+                    if v is None:
+                        cur.pop(p, None)
+                    else:
+                        sch = self.schema.get(p, {"type": "int"})
+                        if sch.get("type") == "[int]":
+                            prev = cur.get(p)
+                            cur[p] = (prev if isinstance(prev, list)
+                                      else ([prev] if prev is not None
+                                            else [])) + [v]
+                        else:
+                            cur[p] = v
+                vs.append((commit_ts, cur))
+            if ckeys:
+                self.commit_log.append((commit_ts, ckeys))
+            return {"data": {"code": "Done"},
+                    "extensions": {"txn": {"commit_ts": commit_ts}}}
+
+    def state(self) -> dict:
+        preds = sorted(self.schema)
+        half = len(preds) // 2 or 1
+        return {"groups": {
+            "1": {"tablets": {p: {"predicate": p, "groupId": 1}
+                              for p in preds[:half]}},
+            "2": {"tablets": {p: {"predicate": p, "groupId": 2}
+                              for p in preds[half:]}}}}
+
+
+class Abort(Exception):
+    pass

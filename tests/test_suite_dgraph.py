@@ -1,0 +1,294 @@
+"""Dgraph suite tests: the HTTP txn client against the in-process SI
+fake, conflict/error classification, checker units, tracing-span
+capture, and hermetic end-to-end runs for every workload."""
+
+import pytest
+
+from fake_dgraph import ABORTED_MSG, FakeDgraph
+
+import jepsen_tpu.db as jdb
+import jepsen_tpu.os_ as jos
+from jepsen_tpu import core, trace
+from jepsen_tpu.suites import dgraph as dg
+from jepsen_tpu.suites.dgraph import (DgraphConn, DgraphError, Txn,
+                                      alter_schema, txn, upsert,
+                                      with_conflict_as_fail)
+
+
+@pytest.fixture
+def fake():
+    f = FakeDgraph()
+    yield f
+    f.stop()
+
+
+def conn_fn(fake):
+    return lambda node: DgraphConn("127.0.0.1", fake.port, timeout_s=5.0)
+
+
+# -- wire client -------------------------------------------------------------
+
+def test_txn_roundtrip(fake):
+    c = DgraphConn("127.0.0.1", fake.port)
+    alter_schema(c, "key: int @index(int) .", "value: int .")
+    with txn(c) as t:
+        uids = t.mutate({"key": 1, "value": 10})
+        assert uids
+    with txn(c) as t:
+        rows = t.query("{ q(func: eq(key, $key)) { uid value } }",
+                       {"key": 1}).get("q")
+        assert rows and rows[0]["value"] == 10
+    c.close()
+
+
+def test_snapshot_isolation(fake):
+    """A txn reads at its start-ts: concurrent commits are invisible."""
+    c1 = DgraphConn("127.0.0.1", fake.port)
+    c2 = DgraphConn("127.0.0.1", fake.port)
+    alter_schema(c1, "key: int @index(int) .", "value: int .")
+    with txn(c1) as t:
+        t.mutate({"key": 5, "value": 1})
+    t1 = Txn(c1)
+    r1 = t1.query("{ q(func: eq(key, $key)) { uid value } }", {"key": 5})
+    assert r1["q"][0]["value"] == 1
+    # another txn commits an update
+    with txn(c2) as t2:
+        rows = t2.query("{ q(func: eq(key, $key)) { uid } }",
+                        {"key": 5})["q"]
+        t2.mutate({"uid": rows[0]["uid"], "value": 2})
+    # t1 still sees its snapshot
+    r1b = t1.query("{ q(func: eq(key, $key)) { uid value } }",
+                   {"key": 5})
+    assert r1b["q"][0]["value"] == 1
+    t1.discard()
+    c1.close()
+    c2.close()
+
+
+def test_write_write_conflict_aborts(fake):
+    c = DgraphConn("127.0.0.1", fake.port)
+    alter_schema(c, "key: int @index(int) .", "value: int .")
+    with txn(c) as t:
+        t.mutate({"key": 9, "value": 0})
+    with txn(c) as t:
+        uid = t.query("{ q(func: eq(key, $key)) { uid } }",
+                      {"key": 9})["q"][0]["uid"]
+    ta, tb = Txn(c), Txn(c)
+    ta.query("{ q(func: eq(key, $key)) { uid } }", {"key": 9})
+    tb.query("{ q(func: eq(key, $key)) { uid } }", {"key": 9})
+    ta.mutate({"uid": uid, "value": 1})
+    tb.mutate({"uid": uid, "value": 2})
+    ta.commit()
+    with pytest.raises(DgraphError) as ei:
+        tb.commit()
+    assert ABORTED_MSG in ei.value.message
+    c.close()
+
+
+def test_upsert_index_conflict(fake):
+    """@upsert predicates conflict on index keys: two blind inserts of
+    the same value race, one must abort."""
+    c = DgraphConn("127.0.0.1", fake.port)
+    alter_schema(c, "email: string @index(exact) @upsert .")
+    ta, tb = Txn(c), Txn(c)
+    ta.query("{ q(func: eq(email, $email)) { uid } }", {"email": "x"})
+    tb.query("{ q(func: eq(email, $email)) { uid } }", {"email": "x"})
+    ta.mutate({"email": "x"})
+    tb.mutate({"email": "x"})
+    ta.commit()
+    with pytest.raises(DgraphError):
+        tb.commit()
+    c.close()
+
+
+def test_error_classification(fake):
+    op = {"f": "read", "process": 0}
+    fake.fail_hook = lambda p, b: \
+        "Conflicts with pending transaction. Please abort." \
+        if p == "/mutate" else None
+    c = DgraphConn("127.0.0.1", fake.port)
+
+    def body():
+        with txn(c) as t:
+            t.mutate({"value": 1})
+        return {**op, "type": "ok"}
+    r = with_conflict_as_fail(op, body,
+                              {"dgraph-conn-retry-delay": 0.0})
+    assert r == {**op, "type": "fail", "error": "conflict"}
+    fake.fail_hook = lambda p, b: "DEADLINE_EXCEEDED: too slow" \
+        if p == "/query" else None
+
+    def body2():
+        with txn(c) as t:
+            t.query("{ q(func: eq(email, $e)) { uid } }", {"e": "y"})
+        return {**op, "type": "ok"}
+    r = with_conflict_as_fail(op, body2,
+                              {"dgraph-conn-retry-delay": 0.0})
+    assert r["type"] == "info" and "timeout" in r["error"]
+    fake.fail_hook = None
+    c.close()
+
+
+def test_upsert_helper(fake):
+    c = DgraphConn("127.0.0.1", fake.port)
+    alter_schema(c, "email: string @index(exact) .")
+    with txn(c) as t:
+        assert upsert(t, "email", {"email": "a"})   # inserted
+    with txn(c) as t:
+        assert upsert(t, "email", {"email": "a"}) is None  # updated
+    c.close()
+
+
+# -- tracing ----------------------------------------------------------------
+
+def test_spans_exported_to_store_dir(fake, tmp_path):
+    done = _run(fake, tmp_path, "set", **{"tracing": True,
+                                          "set-stagger": 0.005})
+    assert done["results"]["valid?"] is True
+    traces = tmp_path / "traces.jsonl"
+    assert traces.exists(), "spans must land in the store dir"
+    import json
+    names = {json.loads(line)["operationName"]
+             for line in traces.read_text().splitlines()}
+    assert {"client.query", "client.mutate", "client.commit"} <= names
+    # and the in-memory buffer agrees
+    assert trace.tracer().spans("client.mutate")
+
+
+def test_bank_annotates_checker_violations(fake, tmp_path):
+    """A mid-run balance violation must tag the live span
+    (`bank.clj:155-168`)."""
+    trace.tracing(str(tmp_path / "t.jsonl"))
+    c = DgraphConn("127.0.0.1", fake.port)
+    client = dg.BankClient()
+    client.conn = c
+    test = {"accounts": [0, 1], "total-amount": 100,
+            "dgraph-conn-retry-delay": 0.0}
+    client.setup(test)
+    # corrupt the bank: add 50 out of thin air
+    with txn(c) as t:
+        rows = t.query("{ q(func: eq(type_0, $type)) { uid amount_0 } }",
+                       {"type": "account"}).get("q")
+        t.mutate({"uid": rows[0]["uid"],
+                  "amount_0": rows[0]["amount_0"] + 50})
+    out = client.invoke(test, {"f": "read", "process": 0})
+    assert out["error"] == "checker-violation"
+    assert out["message"]["type"] == "wrong-total"
+    assert out["message"]["trace-id"] is not None
+    bad = [s for s in trace.tracer().spans()
+           if s["tags"] and any(t["key"] == "checker_violation"
+                                for t in s["tags"])]
+    assert bad, "violation must be tagged on a span"
+    trace.tracing(None)
+    c.close()
+
+
+# -- e2e runs ---------------------------------------------------------------
+
+def _run(fake, tmp_path, workload, time_limit=3, nemesis=(), **opts):
+    t = dg.dgraph_test({
+        "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+        "ssh": {"dummy": True}, "workload": workload,
+        "rate": 200, "time-limit": time_limit,
+        "nemesis": list(nemesis),
+        "store-dir": str(tmp_path),
+        "dgraph-conn-fn": conn_fn(fake),
+        "dgraph-conn-retry-delay": 0.0,
+        **opts})
+    t["db"] = jdb.noop
+    t["os"] = jos.noop
+    return core.run(t)
+
+
+def test_e2e_bank(fake, tmp_path):
+    """upsert-schema makes account creation conflict on index keys —
+    without it, concurrent transfers can create duplicate accounts
+    (the real dgraph anomaly this workload exists to catch)."""
+    done = _run(fake, tmp_path, "bank", **{"upsert-schema": True})
+    assert done["results"]["valid?"] is True
+    reads = [o for o in done["history"]
+             if o.get("f") == "read" and o.get("type") == "ok"]
+    assert reads and all(
+        sum(v for v in r["value"].values() if v) == 100 for r in reads)
+
+
+def test_e2e_upsert(fake, tmp_path):
+    done = _run(fake, tmp_path, "upsert", **{"upsert-schema": True})
+    assert done["results"]["valid?"] is True
+    wl = done["results"]["workload"]
+    assert wl["valid?"] is True
+
+
+def test_e2e_delete(fake, tmp_path):
+    # @upsert: two concurrent upserts of the same key must conflict,
+    # else duplicate records are expected under SI
+    done = _run(fake, tmp_path, "delete",
+                **{"delete-stagger": 0.005, "ops-per-key": 50,
+                   "upsert-schema": True})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_set(fake, tmp_path):
+    done = _run(fake, tmp_path, "set", **{"set-stagger": 0.005})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_uid_set(fake, tmp_path):
+    done = _run(fake, tmp_path, "uid-set", **{"set-stagger": 0.005})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_sequential(fake, tmp_path):
+    done = _run(fake, tmp_path, "sequential")
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_linearizable_register(fake, tmp_path):
+    done = _run(fake, tmp_path, "linearizable-register",
+                **{"per-key-limit": 40})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_uid_linearizable_register(fake, tmp_path):
+    done = _run(fake, tmp_path, "uid-linearizable-register",
+                **{"per-key-limit": 40})
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_long_fork(fake, tmp_path):
+    done = _run(fake, tmp_path, "long-fork")
+    assert done["results"]["valid?"] is True
+
+
+def test_e2e_wr(fake, tmp_path):
+    done = _run(fake, tmp_path, "wr")
+    assert done["results"]["valid?"] is True
+    txns = [o for o in done["history"]
+            if o.get("f") == "txn" and o.get("type") == "ok"]
+    assert txns, "wr run must land transactions"
+
+
+def test_e2e_with_tablet_mover(fake, tmp_path):
+    # wr's 10 striped predicates give the mover 10 tablets per
+    # invocation, so "at least one actual move" is deterministic in
+    # practice (the set workload's 2 tablets made this flaky)
+    done = _run(fake, tmp_path, "wr", time_limit=4,
+                nemesis=("move-tablet",),
+                **{"nemesis-interval": 0.5,
+                   "dgraph-zero-state-fn": lambda node: fake.state(),
+                   "dgraph-move-tablet-fn":
+                       lambda node, pred, group:
+                           fake.moves.append((pred, group))})
+    assert done["results"]["valid?"] is True
+    moves = [o for o in done["history"] if o.get("f") == "move-tablet"]
+    assert moves, "tablet mover must act"
+    assert fake.moves, "tablet moves must reach zero"
+
+
+def test_workload_menu_registered():
+    from jepsen_tpu.suites import suite
+    mod = suite("dgraph")
+    assert set(mod.WORKLOADS) == {
+        "bank", "upsert", "delete", "set", "uid-set", "sequential",
+        "linearizable-register", "uid-linearizable-register",
+        "long-fork", "wr"}
