@@ -26,6 +26,10 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 TOOLS = {"bump-time": "bump_time.cpp",
          "strobe-time": "strobe_time.cpp",
+         # phase-locked variant (the reference's abandoned
+         # strobe-time-experiment.c, finished): flips align to absolute
+         # monotonic ticks instead of drifting relative sleeps
+         "strobe-time-experiment": "strobe_time_experiment.cpp",
          "adj-time": "adj_time.cpp"}
 
 
@@ -89,10 +93,13 @@ def bump_time(delta_ms: float) -> float:
 
 
 def strobe_time(delta_ms: float, period_ms: float,
-                duration_s: float) -> None:
-    """Oscillate this node's clock (`nemesis/time.clj:92-96`)."""
+                duration_s: float, phase_locked: bool = False) -> None:
+    """Oscillate this node's clock (`nemesis/time.clj:92-96`).
+    phase_locked uses the tick-anchored experiment variant, whose flip
+    edges don't drift with per-iteration overhead."""
+    tool = "strobe-time-experiment" if phase_locked else "strobe-time"
     with c.su():
-        c.exec_(f"{DIR}/strobe-time", delta_ms, period_ms, duration_s)
+        c.exec_(f"{DIR}/{tool}", delta_ms, period_ms, duration_s)
 
 
 class ClockNemesis(Nemesis):
@@ -133,7 +140,8 @@ class ClockNemesis(Nemesis):
 
             def go(t, node):
                 s = m[node]
-                strobe_time(s["delta"], s["period"], s["duration"])
+                strobe_time(s["delta"], s["period"], s["duration"],
+                            phase_locked=bool(s.get("phase-locked")))
                 return current_offset()
 
             res = c.on_nodes(test, go, nodes=list(m.keys()))

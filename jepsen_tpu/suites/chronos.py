@@ -293,8 +293,12 @@ def add_job_gen(opts):
                     + gen.rng.randrange(30))
         # run logs record absolute epoch seconds (`date -u +%s.%N`),
         # so schedules must be absolute wall-clock ISO8601 datetimes
-        # too (`chronos.clj:86-107`)
-        start = _time.time() + 10
+        # too (`chronos.clj:86-107`). Whole seconds: the ISO schedule
+        # has second granularity, and a fractional start_epoch would
+        # put the checker's windows fractionally *after* the scheduled
+        # runs. Negative delays schedule jobs in the past — hermetic
+        # tests use that to make run windows due immediately.
+        start = float(int(_time.time() + opts.get("job-start-delay", 10)))
         iso = datetime.datetime.fromtimestamp(
             start, datetime.timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%SZ")

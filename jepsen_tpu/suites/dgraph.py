@@ -1022,14 +1022,15 @@ class DgraphDB(jdb.DB, jdb.Process, jdb.LogFiles):
                          "chdir": DGRAPH_DIR},
                         f"{DGRAPH_DIR}/dgraph", "zero", *args)
 
-    def start_alpha(self, test, node, zero: str | None = None):
+    def start_alpha(self, test, node, zero: str | None = None) -> str:
         from ..control import util as cu
-        cu.start_daemon({"logfile": ALPHA_LOG, "pidfile": ALPHA_PIDFILE,
-                         "chdir": DGRAPH_DIR},
-                        f"{DGRAPH_DIR}/dgraph",
-                        "alpha" if self.version >= "1.1" else "server",
-                        "--my", f"{node}:7080",
-                        "--zero", f"{zero or node}:5080")
+        return cu.start_daemon(
+            {"logfile": ALPHA_LOG, "pidfile": ALPHA_PIDFILE,
+             "chdir": DGRAPH_DIR},
+            f"{DGRAPH_DIR}/dgraph",
+            "alpha" if self.version >= "1.1" else "server",
+            "--my", f"{node}:7080",
+            "--zero", f"{zero or node}:5080")
 
     def stop_alpha(self, test, node):
         from ..control import util as cu
@@ -1112,19 +1113,17 @@ def zero_killer() -> Nemesis:
 
 class AlphaFixer(Nemesis):
     """Speculative alpha restarts — alpha falls over when zero is
-    missing at startup (`nemesis.clj:25-41`)."""
+    missing at startup (`nemesis.clj:25-41`). start-stop-daemon
+    reports whether alpha was actually down, so already-running nodes
+    are recorded as such, as the reference does."""
 
     def fs(self):
         return {"fix-alpha"}
 
     def invoke(self, test, op):
         def fix(t, node):
-            running = test["db"].alpha_running(t, node) \
-                if hasattr(test["db"], "alpha_running") else False
-            if running:
-                return "already-running"
-            test["db"].start_alpha(t, node, zero=test["nodes"][0])
-            return "restarted"
+            res = test["db"].start_alpha(t, node, zero=test["nodes"][0])
+            return "restarted" if res == "started" else res
         nodes = ncomb.random_nonempty_subset(test["nodes"])
         return {**op, "value": control.on_nodes(test, fix, nodes)}
 
@@ -1199,19 +1198,24 @@ def dgraph_nemesis_package(opts: dict) -> dict:
     def _op(f):
         return {"type": "info", "f": f, "value": None}
 
+    # a bare op dict is a ONE-SHOT generator: recurring fault streams
+    # must cycle their op pairs (the yugabyte _role_gen pattern), else
+    # each fault fires once and the rest of the run is fault-free
     if opts.get("kill-alpha"):
         nemeses.append(n_fmap(
             lambda f: {"start": "stop-alpha",
                        "stop": "start-alpha"}.get(f, f), alpha_killer()))
-        gens += [_op("stop-alpha"), _op("start-alpha")]
+        gens.append(itertools.cycle([_op("stop-alpha"),
+                                     _op("start-alpha")]))
     if opts.get("kill-zero"):
         nemeses.append(n_fmap(
             lambda f: {"start": "stop-zero",
                        "stop": "start-zero"}.get(f, f), zero_killer()))
-        gens += [_op("stop-zero"), _op("start-zero")]
+        gens.append(itertools.cycle([_op("stop-zero"),
+                                     _op("start-zero")]))
     if opts.get("fix-alpha"):
         nemeses.append(AlphaFixer())
-        gens.append(_op("fix-alpha"))
+        gens.append(itertools.cycle([_op("fix-alpha")]))
     if opts.get("partition-halves") or opts.get("partition-ring"):
         nemeses.append(n_fmap(
             lambda f: {"start": "start-partition",
@@ -1224,19 +1228,19 @@ def dgraph_nemesis_package(opts: dict) -> dict:
                 return {"type": "info", "f": "start-partition",
                         "value": npart.complete_grudge(
                             npart.bisect(nodes))}
-            gens += [halves, _op("stop-partition")]
+            gens += [halves, itertools.cycle([_op("stop-partition")])]
         if opts.get("partition-ring"):
             def ring(test, ctx):
                 return {"type": "info", "f": "start-partition",
                         "value": npart.majorities_ring(
                             list(test["nodes"]))}
-            gens += [ring, _op("stop-partition")]
+            gens += [ring, itertools.cycle([_op("stop-partition")])]
     if opts.get("move-tablet"):
         nemeses.append(TabletMover())
-        gens.append(_op("move-tablet"))
+        gens.append(itertools.cycle([_op("move-tablet")]))
     if opts.get("skew-clock"):
         nemeses.append(BumpTime())
-        gens += [_op("bump"), _op("reset-time")]
+        gens.append(itertools.cycle([_op("bump"), _op("reset-time")]))
     if not nemeses:
         return ncomb.noop
     finals = []

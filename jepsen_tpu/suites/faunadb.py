@@ -1569,16 +1569,21 @@ def full_generator(n: dict, interval: float):
     """Mixed fault stream per the enabled specs
     (`nemesis.clj:205-233`)."""
     gens: list = []
+    # a bare op dict is a ONE-SHOT generator: recurring fault streams
+    # must cycle their op pairs, else each fault fires exactly once
     if n.get("kill"):
-        gens += [_op("kill"), _op("restart")]
+        gens.append(itertools.cycle([_op("kill"), _op("restart")]))
     if n.get("stop"):
-        gens += [_op("stop"), _op("restart")]
+        gens.append(itertools.cycle([_op("stop"), _op("restart")]))
     if n.get("inter-replica-partition"):
-        gens += [inter_replica_partition_start, _op("stop-partition")]
+        gens += [inter_replica_partition_start,
+                 itertools.cycle([_op("stop-partition")])]
     if n.get("intra-replica-partition"):
-        gens += [intra_replica_partition_start, _op("stop-partition")]
+        gens += [intra_replica_partition_start,
+                 itertools.cycle([_op("stop-partition")])]
     if n.get("single-node-partition"):
-        gens += [single_node_partition_start, _op("stop-partition")]
+        gens += [single_node_partition_start,
+                 itertools.cycle([_op("stop-partition")])]
     if n.get("clock-skew"):
         gens.append(gen.f_map(
             lambda f: {"reset": "reset-clock", "strobe": "strobe-clock",

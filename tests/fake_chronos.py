@@ -1,0 +1,99 @@
+"""In-process Chronos fake: an HTTP scheduler endpoint
+(POST /scheduler/iso8601) plus a run-log simulator that answers the
+dummy remote's `ls`/`cat` commands with the tempfile logs a correctly
+behaving scheduler would have produced — every scheduled run that is
+due by "now" has a log with name/start/end lines (end omitted while a
+run is still in flight). Set ``drop`` to make the scheduler silently
+skip that many due runs (the failure the job-run checker exists to
+catch)."""
+
+from __future__ import annotations
+
+import datetime
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _parse_iso(s: str) -> float:
+    return datetime.datetime.strptime(
+        s, "%Y-%m-%dT%H:%M:%SZ").replace(
+        tzinfo=datetime.timezone.utc).timestamp()
+
+
+class FakeChronos:
+    def __init__(self, drop: int = 0):
+        self.jobs: list[dict] = []
+        self.drop = drop
+        self.lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                m = re.match(r"R(\d+)/(.+)/PT(\d+)S", body["schedule"])
+                sleep = re.search(r"sleep (\d+)", body["command"])
+                with fake.lock:
+                    fake.jobs.append({
+                        "name": int(body["name"]),
+                        "count": int(m.group(1)),
+                        "start": _parse_iso(m.group(2)),
+                        "interval": int(m.group(3)),
+                        "duration": int(sleep.group(1)) if sleep else 0,
+                    })
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- run-log simulation (the dummy remote's ls/cat target) ---------------
+
+    def _due_runs(self) -> list[dict]:
+        now = time.time()
+        runs = []
+        with self.lock:
+            jobs = list(self.jobs)
+            drop = self.drop
+        for j in jobs:
+            for k in range(j["count"]):
+                t0 = j["start"] + k * j["interval"]
+                if t0 > now:
+                    break
+                run = {"file": f"run-{j['name']}-{k}",
+                       "name": j["name"], "start": t0 + 0.01}
+                if t0 + 0.01 + j["duration"] <= now:
+                    run["end"] = t0 + 0.01 + j["duration"]
+                runs.append(run)
+        if drop:
+            runs = runs[drop:]
+        return runs
+
+    def remote_responder(self, context: dict, action: dict) -> dict:
+        cmd = action.get("cmd", "")
+        if re.search(r"\bls\b", cmd):
+            return {"exit": 0, "out": "\n".join(
+                r["file"] for r in self._due_runs())}
+        m = re.search(r"\bcat\b.*?(run-\d+-\d+)", cmd)
+        if m:
+            for r in self._due_runs():
+                if r["file"] == m.group(1):
+                    lines = [str(r["name"]), f"{r['start']:.3f}"]
+                    if "end" in r:
+                        lines.append(f"{r['end']:.3f}")
+                    return {"exit": 0, "out": "\n".join(lines) + "\n"}
+            return {"exit": 1, "err": "No such file"}
+        return {"exit": 0, "out": ""}

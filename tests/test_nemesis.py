@@ -325,6 +325,8 @@ class TestNativeTools:
         src = ntime.NATIVE_DIR
         for b, s in [("bump_time", "bump_time.cpp"),
                      ("strobe_time", "strobe_time.cpp"),
+                     ("strobe_time_experiment",
+                      "strobe_time_experiment.cpp"),
                      ("adj_time", "adj_time.cpp")]:
             subprocess.run(["g++", "-O2", "-std=c++17", "-o",
                             str(d / b), f"{src}/{s}"], check=True)
@@ -333,7 +335,8 @@ class TestNativeTools:
     def test_usage_exits_nonzero(self, bins):
         import subprocess
 
-        for b in ("bump_time", "strobe_time", "adj_time"):
+        for b in ("bump_time", "strobe_time",
+                  "strobe_time_experiment", "adj_time"):
             p = subprocess.run([str(bins / b)], capture_output=True)
             assert p.returncode == 1
             assert b"usage" in p.stderr
@@ -346,6 +349,17 @@ class TestNativeTools:
         # fails with exit 2 — either outcome proves arg parsing + flow.
         p = subprocess.run([str(bins / "strobe_time"), "10", "5", "0"],
                            capture_output=True)
+        assert p.returncode in (0, 2)
+        if p.returncode == 0:
+            assert p.stdout.strip() == b"0"
+
+    def test_strobe_experiment_zero_duration_restores(self, bins):
+        import subprocess
+
+        # phase-locked variant: same zero-duration contract
+        p = subprocess.run(
+            [str(bins / "strobe_time_experiment"), "10", "5", "0"],
+            capture_output=True)
         assert p.returncode in (0, 2)
         if p.returncode == 0:
             assert p.stdout.strip() == b"0"

@@ -292,3 +292,26 @@ def test_workload_menu_registered():
         "bank", "upsert", "delete", "set", "uid-set", "sequential",
         "linearizable-register", "uid-linearizable-register",
         "long-fork", "wr"}
+
+
+def test_nemesis_fault_stream_recurs():
+    """Fault schedules must repeat for the whole run, not fire once
+    (bare op dicts are one-shot generators)."""
+    from jepsen_tpu import generator as g
+
+    pkg = dg.dgraph_nemesis_package({"kill-alpha": True,
+                                     "interval": 0.001})
+    ctx = g.context({"concurrency": 2})
+    stream = pkg["generator"]
+    fs = []
+    for _ in range(8):
+        res = g.op(stream, {"nodes": ["n1"]}, ctx)
+        assert res is not None, "nemesis stream exhausted"
+        o, stream = res
+        if o is g.PENDING:
+            continue
+        fs.append(o["f"])
+        ctx = g.Context(ctx.time + 10_000_000, ctx.free_threads,
+                        ctx.workers)
+    assert fs.count("stop-alpha") >= 2, fs
+    assert fs.count("start-alpha") >= 2, fs

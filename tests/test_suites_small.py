@@ -292,3 +292,75 @@ def test_rethinkdb_db_commands():
                       if isinstance(a.get("in"), str))
     assert "join=n1:29015" in stdins and "join=n3:29015" in stdins
     assert "faketime -m -f" in stdins
+
+
+def test_robustirc_hermetic_run_catches_lost_messages(tmp_path):
+    """A network that drops an acknowledged TOPIC must flip the set
+    checker — proves the e2e wiring detects loss, not just success."""
+    f = FakeRobustIRC()
+    try:
+        dropped = {"n": 0}
+
+        class LossyLog(list):
+            def append(self, m):
+                # silently drop the third acknowledged TOPIC
+                if "TOPIC" in m.get("Data", ""):
+                    dropped["n"] += 1
+                    if dropped["n"] == 3:
+                        return
+                super().append(m)
+
+        f.messages = LossyLog(f.messages)
+
+        t = robustirc.robustirc_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"]})
+        t["db"] = jepsen_tpu.db.noop
+        t["os"] = jepsen_tpu.os_.noop
+        t["irc-url-fn"] = lambda n: f"http://127.0.0.1:{f.port}"
+        t["store-dir"] = str(tmp_path / "store")
+        done = core.run(t)
+        assert dropped["n"] >= 3, "history must reach the dropped op"
+        w = done["results"]["workload"]
+        assert w["valid?"] is False and w["lost-count"] >= 1, w
+    finally:
+        f.stop()
+
+
+def test_logcabin_hermetic_run_catches_stale_reads(tmp_path):
+    """A register that answers reads from a stale snapshot (the first
+    value ever written, forever) must be flagged nonlinearizable end
+    to end. Note nil reads are *unconstrained* (knossos parity), so
+    the stale value must be concrete."""
+    sim = _LogCabinSim()
+    stale = {}
+
+    class _StaleSim:
+        def __call__(self, context, action):
+            cmd = action.get("cmd", "")
+            r = sim(context, action)
+            if " read /jepsen" in cmd:
+                # pin reads to the first written value forever
+                if "value" not in stale and sim.value != "null":
+                    stale["value"] = sim.value
+                return {"exit": 0,
+                        "out": stale.get("value", "null")}
+            return r
+
+    remote = dummy.remote(responses={r"TreeOps": _StaleSim()})
+    t = logcabin.logcabin_test({
+        "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+        "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+        "faults": ["none"]})
+    t["db"] = jepsen_tpu.db.noop
+    t["os"] = jepsen_tpu.os_.noop
+    t["remote"] = remote
+    t["store-dir"] = str(tmp_path / "store")
+    done = core.run(t)
+    writes = sum(1 for o in done["history"]
+                 if o.get("f") == "write" and o.get("type") == "ok")
+    reads = sum(1 for o in done["history"]
+                if o.get("f") == "read" and o.get("type") == "ok")
+    assert writes and reads
+    assert done["results"]["workload"]["valid?"] is False

@@ -260,3 +260,64 @@ def test_chronos_db_commands():
     stdins = " ".join(a.get("in", "") for _h, _c, a in log
                       if isinstance(a.get("in"), str))
     assert "zk://n1:2181,n2:2181,n3:2181/mesos" in stdins
+
+
+def test_chronos_hermetic_run(tmp_path):
+    """Full core.run against the fake scheduler: jobs submitted over
+    real HTTP, run logs read back through the dummy remote, and the
+    job-run checker issuing a substantive verdict."""
+    from fake_chronos import FakeChronos
+
+    f = FakeChronos()
+    try:
+        t = chronos.chronos_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"],
+            "job-interval": 0.4, "job-start-delay": -120})
+        t["remote"] = dummy.remote(responses={
+            r"\bls\b|\bcat\b": f.remote_responder})
+        done = _hermetic(
+            t, tmp_path,
+            **{"chronos-url-fn":
+               lambda n: f"http://127.0.0.1:{f.port}"})
+        assert done["results"]["valid?"] is True, done["results"]
+        w = done["results"]["workload"]
+        assert w["job-count"] >= 3, "jobs must be submitted"
+        assert any(s["complete"] > 0 for s in w["jobs"].values()), \
+            "past-scheduled jobs must show completed runs"
+    finally:
+        f.stop()
+
+
+def test_chronos_hermetic_run_catches_dropped_runs(tmp_path):
+    """A scheduler that silently skips due runs must be flagged."""
+    from fake_chronos import FakeChronos
+
+    f = FakeChronos(drop=2)
+    try:
+        t = chronos.chronos_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"],
+            "job-interval": 0.4, "job-start-delay": -120})
+        t["remote"] = dummy.remote(responses={
+            r"\bls\b|\bcat\b": f.remote_responder})
+        done = _hermetic(
+            t, tmp_path,
+            **{"chronos-url-fn":
+               lambda n: f"http://127.0.0.1:{f.port}"})
+        assert done["results"]["workload"]["valid?"] is False
+    finally:
+        f.stop()
+
+
+def test_chronos_error_classification(tmp_path):
+    """A dead scheduler endpoint classifies as a definite fail."""
+    c = chronos.Client().open({"chronos-url-fn":
+                               lambda n: "http://127.0.0.1:1"}, "n1")
+    r = c.invoke({}, {"type": "invoke", "f": "add-job", "process": 0,
+                      "value": {"name": 1, "start": "2026-01-01T00:00:00Z",
+                                "count": 1, "duration": 1, "epsilon": 10,
+                                "interval": 30}})
+    assert r["type"] == "fail" and r["error"]
