@@ -177,10 +177,12 @@ def graph(hist):
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
     edges: dict[tuple, set] = {}
+    _setdefault = edges.setdefault
 
     def add(i, j, typ):
+        # hot path: ~5 calls per op on 100k-txn histories
         if i != j:
-            edges.setdefault((i, j), set()).add(typ)
+            _setdefault((i, j), set()).add(typ)
 
     orders, incompatible = a.version_orders()
     # ww along each key's observed version chain

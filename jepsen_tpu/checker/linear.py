@@ -102,15 +102,22 @@ def analysis_host(model: m.Model, hist, budget_s: float | None = None,
     pending: dict[int, dict] = {}
     op_count = sum(1 for e in events if e[0] == "invoke")
     previous_ok = None
+    processed = 0
     for kind, op_id, op in events:
         if budget_s is not None and _time.monotonic() - t0 > budget_s:
+            # ops-processed lets callers extrapolate total runtime (a
+            # lower bound: per-op cost is nondecreasing as the pending
+            # set and config space grow)
             return {"valid?": UNKNOWN, "analyzer": "host-jit-linear",
                     "op-count": op_count, "cause": "budget exhausted",
+                    "ops-processed": processed,
                     "duration-ms": (_time.monotonic() - t0) * 1e3}
         if cancel is not None and cancel():
             return {"valid?": UNKNOWN, "analyzer": "host-jit-linear",
                     "op-count": op_count, "cause": "cancelled",
+                    "ops-processed": processed,
                     "duration-ms": (_time.monotonic() - t0) * 1e3}
+        processed += kind == "invoke"
         if kind == "invoke":
             pending[op_id] = op
             continue

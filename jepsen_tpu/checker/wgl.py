@@ -783,7 +783,10 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
              & (new[:, :, None] == S_VALS[None, None, :]))      # (P,S,S2)
         Mf = M.astype(f32)
 
-        # fixpoint: iterate while the popcount grows
+        # fixpoint: iterate while the popcount grows. M (the P x S x S
+        # transition tensor) is computed once per invoke above, outside
+        # the loop — XLA hoists it as a loop constant; only the table
+        # changes per round.
         def wcond(c):
             tb, cnt, prev = c
             return cnt != prev
