@@ -9,9 +9,11 @@ Covers every BASELINE.md config plus the adversarial headline proof:
     writes (the shape the reference calls out at `checker.clj:213-216`
     — ":info ops hold slots forever", hours/32 GB on CPU knossos).
     The host oracle is *measured* against a 60 s budget on this exact
-    history (it blows it; full-run measurements put it past 450 s);
-    the device answers exactly. The reported speedup is a lower bound
-    (budget / device time), not an assumed timeout.
+    history; when it blows the budget, its total runtime is projected
+    linearly from the ops it processed (a lower bound: per-op cost is
+    nondecreasing in this shape), capped at the 1 h north star. The
+    reported speedup is projected-host-time / device-time — derived
+    from measurement, never an assumed timeout.
   * extra.configs: BASELINE configs 1-5 —
       1 tutorial-scale 200-op register (CPU parity),
       2 zookeeper-shape 2k-op WGL register,
@@ -81,17 +83,18 @@ def main() -> int:
     t0 = time.monotonic()
     ta = analysis_tpu(model, adv, budget_s=420)
     adv_tpu_s = time.monotonic() - t0
+    from jepsen_tpu.checker import UNKNOWN
+
     t0 = time.monotonic()
     host = analysis_host(model, adv, budget_s=HOST_BUDGET_S)
     adv_host_s = time.monotonic() - t0
-    UNKNOWN_V = "unknown"
     # Honest speedup: when the host blows its budget, extrapolate its
     # total runtime linearly from the ops it processed. That is a
     # LOWER bound — per-op cost in this front-loaded shape is
     # nondecreasing (the crashed writes pend forever, so the closure
     # per event never shrinks) — so the reported speedup is what we
     # can actually prove, not an assumed timeout.
-    host_decided = host["valid?"] is not UNKNOWN_V
+    host_decided = host["valid?"] != UNKNOWN
     host_info = {"budget_s": HOST_BUDGET_S,
                  "completed_in_budget": host_decided,
                  "seconds": round(adv_host_s, 1),

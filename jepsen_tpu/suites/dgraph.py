@@ -979,6 +979,124 @@ def wr_workload(opts: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# types (`types.clj`)
+# ---------------------------------------------------------------------------
+
+def _type_cases() -> list[tuple[str, int]]:
+    """[attribute, value] probes around integer-width boundaries
+    (`types.clj:133-158`): byte/short/int/long maxima, the largest
+    exactly-float- and double-representable integers, and values well
+    outside signed 64-bit range."""
+    points = [0, 2**7 - 1, 2**15 - 1, 2**31 - 1, 2**63 - 1,
+              16777217, 9007199254740993, 3 * (2**63 - 1)]
+    vals: list[int] = []
+    for x in points:
+        vals.extend(range(x - 8, x + 8))
+        vals.extend(range(-x - 8, -x + 8))
+    return [(a, v) for a in ("foo", "int64") for v in vals]
+
+
+class TypesClient(_DgraphClient):
+    """Writes boundary integers as fresh entities, then reads them
+    back by uid (`types.clj:24-57`)."""
+
+    def __init__(self):
+        super().__init__()
+        self.entities: list = []
+        self.lock = threading.Lock()
+
+    def setup(self, test):
+        alter_schema(self.conn, "key: int @index(int) .",
+                     "int64: int .", "foo: int .")
+
+    def invoke(self, test, op):
+        def body():
+            e, a, v = op["value"]
+            with txn(self.conn) as t:
+                if op["f"] == "write":
+                    uids = t.mutate({a: v})
+                    uid = next(iter(uids.values()))
+                    with self.lock:
+                        self.entities.append(uid)
+                    return {**op, "type": "ok", "value": [uid, a, v]}
+                rows = t.query("{ q(func: uid($entity)) { " + a + " } }",
+                               {"entity": e}).get("q") or []
+                got = rows[0].get(a) if rows else None
+                return {**op, "type": "ok", "value": [e, a, got]}
+        return with_conflict_as_fail(op, body, test)
+
+
+class TypesChecker(checker.Checker):
+    """Everything written must read back bit-identical
+    (`types.clj:59-125`); written-but-never-read entities degrade the
+    verdict to unknown."""
+
+    def check(self, test, hist, opts):
+        state: dict = {}
+        for o in hist:
+            if o.get("type") == "ok" and o.get("f") == "write":
+                e, a, v = o["value"]
+                state[(e, a)] = v
+        read_keys = set()
+        errs = []
+        for o in hist:
+            if o.get("type") != "ok" or o.get("f") != "read":
+                continue
+            e, a, v = o["value"]
+            read_keys.add((e, a))
+            if (e, a) in state and v != state[(e, a)]:
+                errs.append({"entity": e, "attribute": a,
+                             "wrote": state[(e, a)], "read": v})
+        unread = sorted(k for k in state if k not in read_keys)
+        # distinct errors, preserving order
+        seen = set()
+        distinct = []
+        for err in errs:
+            key = (err["entity"], err["attribute"], str(err["wrote"]),
+                   str(err["read"]))
+            if key not in seen:
+                seen.add(key)
+                distinct.append(err)
+        return {"valid?": (False if errs else
+                           "unknown" if unread else True),
+                "error-count": len(distinct),
+                "bad-read-count": len(errs),   # raw, pre-dedup (3x reads)
+                "unread-count": len(unread),
+                "errors": distinct,
+                "unread": unread[:16]}
+
+
+def types_workload(opts: dict) -> dict:
+    client = TypesClient()
+    cases = _type_cases()
+    if opts.get("type-cases"):
+        # sample evenly (ceil stride, no truncation) so shortened runs
+        # still hit the 2^53+ tail for both attributes
+        stride = -(-len(cases) // opts["type-cases"])
+        cases = cases[::stride]
+    writes = gen.IterGen(
+        {"type": "invoke", "f": "write", "value": [None, a, v]}
+        for a, v in cases)
+
+    def reads(test, ctx):
+        attrs = sorted({a for a, _ in cases})
+        with client.lock:
+            ents = list(client.entities)
+        ops = [{"type": "invoke", "f": "read", "value": [e, a, None]}
+               for _ in range(3) for e in ents for a in attrs]
+        gen.rng.shuffle(ops)
+        return gen.stagger(opts.get("types-stagger", 1 / 10),
+                           gen.IterGen(iter(ops)))
+
+    return {"client": client,
+            "checker": TypesChecker(),
+            "generator": gen.phases(
+                gen.stagger(opts.get("types-stagger", 1 / 10), writes),
+                gen.sleep(opts.get("types-settle", 10)),
+                gen.derefer(reads))}
+
+
+# ---------------------------------------------------------------------------
 # Support: zero/alpha daemons (`support.clj`)
 # ---------------------------------------------------------------------------
 
@@ -1275,7 +1393,12 @@ WORKLOADS = {
     "uid-linearizable-register": uid_linearizable_register_workload,
     "long-fork": long_fork_workload,
     "wr": wr_workload,
+    "types": types_workload,
 }
+
+# the test-all sweep runs everything but types, as the reference does
+# (`core.clj:43-45`); consumed by main()'s test-all command
+STANDARD_WORKLOADS = sorted(set(WORKLOADS) - {"types"})
 
 STANDARD_NEMESES = [
     {},
@@ -1359,9 +1482,20 @@ OPT_SPEC = [
 ]
 
 
+def _all_tests(opts):
+    """One test per standard workload x nemesis set
+    (`core.clj:215-231` all-tests)."""
+    for nem in STANDARD_NEMESES:
+        for w in STANDARD_WORKLOADS:
+            yield dgraph_test({**opts, "workload": w,
+                               "nemesis": sorted(nem)})
+
+
 def main(argv=None):
     cli.run({**cli.single_test_cmd({"test_fn": dgraph_test,
                                     "opt_spec": OPT_SPEC}),
+             **cli.test_all_cmd({"tests_fn": _all_tests,
+                                 "opt_spec": OPT_SPEC}),
              **cli.serve_cmd()}, argv)
 
 

@@ -31,7 +31,11 @@ _QUERY_RE = re.compile(
 
 
 class FakeDgraph:
-    def __init__(self):
+    def __init__(self, float_coerce: bool = False):
+        # float_coerce models real dgraph's JSON number handling:
+        # integers round-trip through float64, silently corrupting
+        # values beyond 2^53 (what the types workload exists to catch)
+        self.float_coerce = float_coerce
         self.schema: dict[str, dict] = {}   # pred -> {index, upsert, type}
         # uid -> list of (ts, {pred: value} | None)
         self.nodes: dict[str, list] = {}
@@ -234,6 +238,10 @@ class FakeDgraph:
             uids_out = {}
             for obj in body.get("set") or []:
                 obj = dict(obj)
+                if self.float_coerce:
+                    obj = {p: (int(float(v)) if isinstance(v, int)
+                               and not isinstance(v, bool) else v)
+                           for p, v in obj.items()}
                 uid = obj.pop("uid", None)
                 if uid is None:
                     self.next_uid += 1

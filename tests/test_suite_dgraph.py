@@ -291,7 +291,8 @@ def test_workload_menu_registered():
     assert set(mod.WORKLOADS) == {
         "bank", "upsert", "delete", "set", "uid-set", "sequential",
         "linearizable-register", "uid-linearizable-register",
-        "long-fork", "wr"}
+        "long-fork", "wr", "types"}
+    assert "types" not in mod.STANDARD_WORKLOADS
 
 
 def test_nemesis_fault_stream_recurs():
@@ -315,3 +316,29 @@ def test_nemesis_fault_stream_recurs():
                         ctx.workers)
     assert fs.count("stop-alpha") >= 2, fs
     assert fs.count("start-alpha") >= 2, fs
+
+
+def test_e2e_types_exact(fake, tmp_path):
+    """A store with exact integers passes the type-safety probe."""
+    done = _run(fake, tmp_path, "types", time_limit=6,
+                **{"type-cases": 40, "types-stagger": 0.002,
+                   "types-settle": 0.2})
+    w = done["results"]["workload"]
+    assert w["valid?"] in (True, "unknown"), w
+    assert w["error-count"] == 0, w
+
+
+def test_e2e_types_catches_float_coercion(tmp_path):
+    """A store that round-trips integers through float64 (real
+    dgraph's JSON path) must be flagged: values past 2^53 corrupt."""
+    f = FakeDgraph(float_coerce=True)
+    try:
+        done = _run(f, tmp_path, "types", time_limit=6,
+                    **{"type-cases": 60, "types-stagger": 0.002,
+                       "types-settle": 0.2})
+        w = done["results"]["workload"]
+        assert w["valid?"] is False and w["error-count"] > 0, w
+        bad = w["errors"][0]
+        assert bad["wrote"] != bad["read"]
+    finally:
+        f.stop()
