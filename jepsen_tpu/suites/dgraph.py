@@ -1479,6 +1479,12 @@ OPT_SPEC = [
             help="add @upsert to indexed predicates"),
     cli.opt("--tracing", default=None,
             help="Jaeger HTTP endpoint or file path for client spans"),
+    cli.opt("--type-cases", type=int, default=None,
+            help="types: sample this many boundary cases evenly"),
+    cli.opt("--types-stagger", type=float, default=1 / 10,
+            help="types: seconds between ops"),
+    cli.opt("--types-settle", type=float, default=10,
+            help="types: seconds between write and read phases"),
 ]
 
 
