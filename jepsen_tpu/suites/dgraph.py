@@ -1066,6 +1066,10 @@ class TypesChecker(checker.Checker):
                 "unread": unread[:16]}
 
 
+TYPES_STAGGER_DEFAULT = 1 / 10
+TYPES_SETTLE_DEFAULT = 10.0
+
+
 def types_workload(opts: dict) -> dict:
     client = TypesClient()
     cases = _type_cases()
@@ -1085,14 +1089,14 @@ def types_workload(opts: dict) -> dict:
         ops = [{"type": "invoke", "f": "read", "value": [e, a, None]}
                for _ in range(3) for e in ents for a in attrs]
         gen.rng.shuffle(ops)
-        return gen.stagger(opts.get("types-stagger", 1 / 10),
+        return gen.stagger(opts.get("types-stagger", TYPES_STAGGER_DEFAULT),
                            gen.IterGen(iter(ops)))
 
     return {"client": client,
             "checker": TypesChecker(),
             "generator": gen.phases(
-                gen.stagger(opts.get("types-stagger", 1 / 10), writes),
-                gen.sleep(opts.get("types-settle", 10)),
+                gen.stagger(opts.get("types-stagger", TYPES_STAGGER_DEFAULT), writes),
+                gen.sleep(opts.get("types-settle", TYPES_SETTLE_DEFAULT)),
                 gen.derefer(reads))}
 
 
@@ -1481,9 +1485,10 @@ OPT_SPEC = [
             help="Jaeger HTTP endpoint or file path for client spans"),
     cli.opt("--type-cases", type=int, default=None,
             help="types: sample this many boundary cases evenly"),
-    cli.opt("--types-stagger", type=float, default=1 / 10,
+    cli.opt("--types-stagger", type=float,
+            default=TYPES_STAGGER_DEFAULT,
             help="types: seconds between ops"),
-    cli.opt("--types-settle", type=float, default=10,
+    cli.opt("--types-settle", type=float, default=TYPES_SETTLE_DEFAULT,
             help="types: seconds between write and read phases"),
 ]
 

@@ -23,14 +23,25 @@ class FakeAerospike:
         self.srv.listen(64)
         self.port = self.srv.getsockname()[1]
         self.running = True
+        self._conns: list = []
         threading.Thread(target=self._accept, daemon=True).start()
 
     def stop(self):
+        """Shut down fully: close the listener AND every accepted
+        session socket, so in-flight clients see the server die
+        (tests rely on this to exercise error classification)."""
         self.running = False
         try:
             self.srv.close()
         except OSError:
             pass
+        with self.lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _accept(self):
         while self.running:
@@ -38,6 +49,11 @@ class FakeAerospike:
                 conn, _ = self.srv.accept()
             except OSError:
                 return
+            with self.lock:
+                if not self.running:
+                    conn.close()
+                    continue
+                self._conns.append(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 

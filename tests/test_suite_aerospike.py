@@ -126,11 +126,20 @@ def test_client_connection_error_classification():
         t = conn_test(f)
         c = aerospike.CasRegisterClient().open(t, "n1")
         f.stop()  # server goes away mid-session
-        r = c.invoke(t, {"type": "invoke", "f": "write",
-                         "value": ktuple(0, 1), "process": 0})
+        # shutdown races the in-flight buffers: an op issued right at
+        # stop() may still complete; the first op to hit the dead
+        # socket must classify correctly
+        for _ in range(5):
+            r = c.invoke(t, {"type": "invoke", "f": "write",
+                             "value": ktuple(0, 1), "process": 0})
+            if r["type"] != "ok":
+                break
         assert r["type"] == "info", r
-        r = c.invoke(t, {"type": "invoke", "f": "read",
-                         "value": ktuple(0, None), "process": 0})
+        for _ in range(5):
+            r = c.invoke(t, {"type": "invoke", "f": "read",
+                             "value": ktuple(0, None), "process": 0})
+            if r["type"] != "ok":
+                break
         assert r["type"] == "fail", r
     finally:
         f.stop()
