@@ -1875,9 +1875,22 @@ OPT_SPEC = [
 ]
 
 
+def _all_tests(opts):
+    """The full sweep: every workload-option combination expected to
+    pass, crossed with every nemesis set (`runner.clj:215-231`
+    all-tests over workload-options-expected-to-pass x all-nemeses)."""
+    for nem in ALL_NEMESES:
+        for combo in all_workload_options(
+                WORKLOAD_OPTIONS_EXPECTED_TO_PASS):
+            yield faunadb_test({**opts, **combo,
+                                "nemesis": sorted(nem)})
+
+
 def main(argv=None):
     cli.run({**cli.single_test_cmd({"test_fn": faunadb_test,
                                     "opt_spec": OPT_SPEC}),
+             **cli.test_all_cmd({"tests_fn": _all_tests,
+                                 "opt_spec": OPT_SPEC}),
              **cli.serve_cmd()}, argv)
 
 

@@ -368,3 +368,16 @@ def test_workload_menu_registered():
     assert set(mod.WORKLOADS) == {
         "register", "bank", "bank-index", "g2", "set", "pages",
         "monotonic", "multimonotonic", "internal"}
+
+
+def test_all_tests_sweep_builds():
+    """The test-all sweep must build every workload x nemesis combo
+    without constructing errors (matching runner.clj's all-tests)."""
+    tests = list(fdb._all_tests({
+        "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+        "ssh": {"dummy": True}, "time-limit": 1}))
+    assert len(tests) == len(fdb.ALL_NEMESES) * len(
+        fdb.all_workload_options(fdb.WORKLOAD_OPTIONS_EXPECTED_TO_PASS))
+    names = {t["name"] for t in tests}
+    assert any("register" in n for n in names)
+    assert any("strong-read" in n for n in names)
