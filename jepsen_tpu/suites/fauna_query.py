@@ -200,6 +200,21 @@ def non_empty(x) -> dict:
     return {"non_empty": x}
 
 
+def union(*sets) -> dict:
+    """Set union over index matches (`query.clj:275-282`)."""
+    return {"union": list(sets)}
+
+
+def intersection(*sets) -> dict:
+    """Set intersection over index matches (`query.clj:284-291`)."""
+    return {"intersection": list(sets)}
+
+
+def singleton(r) -> dict:
+    """Lift a ref into a one-element set (`query.clj:328-330`)."""
+    return {"singleton": r}
+
+
 def cond(*clauses) -> dict:
     """cond-style chain: pairs of (test, expr) with an optional final
     default (`query.clj:174-185`)."""
@@ -223,7 +238,7 @@ for _name in ("class_", "index", "ref", "var", "let", "if_", "when", "do",
               "exists", "select", "create_class", "create_index", "match",
               "paginate", "events", "time", "at", "abort", "add",
               "subtract", "lt", "eq", "not_", "and_", "or_", "non_empty",
-              "cond"):
+              "union", "intersection", "singleton", "cond"):
     globals()[_name] = _mark(globals()[_name])
 
 NOW = Expr({"time": "now"})

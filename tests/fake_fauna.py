@@ -247,6 +247,30 @@ class _Txn:
         if "match" in e:
             return {"@match": ev(e["match"]),
                     "@term": ev(e.get("terms")) if "terms" in e else None}
+        if "union" in e or "intersection" in e:
+            op_name = "union" if "union" in e else "intersection"
+            args = e[op_name]
+            if not args:
+                raise Fault(400, "invalid expression",
+                            f"{op_name} needs at least one set")
+
+            def key(r):
+                return json.dumps(r, sort_keys=True, default=str)
+
+            # set semantics throughout, as real Fauna's Union/
+            # Intersection: dedupe within every argument set too
+            rows_sets = [dict.fromkeys(key(r)
+                                       for r in self._set_rows(ev(x), at))
+                         for x in args]
+            out = set(rows_sets[0])
+            for ks in rows_sets[1:]:
+                out = out | set(ks) if op_name == "union" \
+                    else out & set(ks)
+            return {"@rows": [json.loads(k) for k in sorted(out)]}
+        if "singleton" in e:
+            r = ev(e["singleton"])
+            # the empty set when the doc doesn't exist at the read ts
+            return {"@rows": [r] if self._exists(r, at) else []}
         if "events" in e:
             r = ev(e["events"])
             return {"@events": r}
@@ -340,17 +364,26 @@ class _Txn:
         self._write(cls, id_, None)
         return self._instance(cls, id_, self.ts, live[1])
 
+    def _set_rows(self, src, at) -> list:
+        """Resolve a set value (index match, union/intersection rows,
+        or a plain array) to its row list."""
+        if isinstance(src, dict) and "@match" in src:
+            idx = self.db.indexes.get(src["@match"].get("index"))
+            if idx is None:
+                raise Fault(404, "instance not found", "no such index")
+            return self._match(idx, src["@term"], at)
+        if isinstance(src, dict) and "@rows" in src:
+            return src["@rows"]
+        return src if isinstance(src, list) else [src]
+
     def _paginate(self, e, env, at):
         src = self.eval(e["paginate"], env, at)
         size = e.get("size", 64)
         after = e.get("after")
         if isinstance(after, dict):
             after = self.eval(after, env, at)
-        if isinstance(src, dict) and "@match" in src:
-            idx = self.db.indexes.get(src["@match"].get("index"))
-            if idx is None:
-                raise Fault(404, "instance not found", "no such index")
-            rows = self._match(idx, src["@term"], at)
+        if isinstance(src, dict) and ("@match" in src or "@rows" in src):
+            rows = self._set_rows(src, at)
         elif isinstance(src, dict) and "@events" in src:
             r = src["@events"]
             cls, id_ = r["ref"]["class"], r["id"]

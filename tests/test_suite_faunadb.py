@@ -381,3 +381,32 @@ def test_all_tests_sweep_builds():
     names = {t["name"] for t in tests}
     assert any("register" in n for n in names)
     assert any("strong-read" in n for n in names)
+
+
+def test_union_intersection_singleton(fake):
+    """Set algebra forms (`query.clj:275-291,328-330`)."""
+    c = FaunaConn("127.0.0.1", fake.port)
+    c.query(q.create_class({"name": "s"}))
+    for name, vals in (("by-a", [1, 2, 3]), ("by-b", [2, 3, 4])):
+        c.query(q.create_index({
+            "name": name, "source": q.class_("s"), "active": True,
+            "terms": [{"field": ["data", "tag"]}],
+            "values": [{"field": ["data", "v"]}]}))
+    tag = {"by-a": "a", "by-b": "b"}
+    for t, vs in (("a", [1, 2, 3]), ("b", [2, 3, 4])):
+        for v in vs:
+            c.query(q.create(q.class_("s"),
+                             {"data": {"tag": t, "v": v}}))
+    u = fdb.query_all(c, q.union(q.match(q.index("by-a"), "a"),
+                                 q.match(q.index("by-b"), "b")))
+    assert sorted(u) == [1, 2, 3, 4]
+    i = fdb.query_all(c, q.intersection(q.match(q.index("by-a"), "a"),
+                                        q.match(q.index("by-b"), "b")))
+    assert sorted(i) == [2, 3]
+    # singleton: one element for a live doc, empty for a missing one
+    c.query(q.create(q.ref("s", 99), {"data": {"tag": "z", "v": 9}}))
+    s = c.query(q.paginate(q.singleton(q.ref("s", 99)), size=4))
+    assert len(s["data"]) == 1
+    s = c.query(q.paginate(q.singleton(q.ref("s", 12345)), size=4))
+    assert s["data"] == []
+    c.close()
