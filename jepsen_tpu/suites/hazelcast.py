@@ -212,25 +212,52 @@ class QueueClient(CPClient):
 # -- semaphore checker (suite-local, like the reference's) -------------------
 
 class SemaphoreChecker(checker.Checker):
-    """At most N permits held at once, judged from ok acquires/releases."""
+    """At most N permits *certainly* held at once.
+
+    A permit is certainly held from the acquire's completion until the
+    holder's next release *invocation*: the release takes effect
+    somewhere between its invoke and its completion, so a concurrent
+    acquire granted against the freed permit can journal its ok before
+    the release's ok. Counting releases at completion (the naive
+    replay) therefore flags that legal interleaving as over-capacity.
+    Ending intervals at release-invoke is conservative — only genuine
+    overlaps of > N certain-hold intervals are flagged."""
 
     def __init__(self, permits: int = 2):
         self.permits = permits
 
     def check(self, test, hist, opts):
-        holders: set = set()
+        holds: dict = {}          # process -> certainly-held permits
+        tentative: set = set()    # processes with an in-flight release
         over = []
+
+        def flag(o):
+            over.append({"op": dict(o),
+                         "holders": {str(p): n for p, n
+                                     in sorted(holds.items()) if n}})
+
         for o in hist:
-            if o.get("type") != "ok":
-                continue
             p = o.get("process")
-            if o.get("f") == "acquire":
-                holders.add(p)
-                if len(holders) > self.permits:
-                    over.append({"op": dict(o),
-                                 "holders": sorted(map(str, holders))})
-            elif o.get("f") == "release":
-                holders.discard(p)
+            f = o.get("f")
+            t = o.get("type")
+            if f == "release":
+                if t == "invoke":
+                    if holds.get(p, 0) > 0:
+                        holds[p] -= 1
+                        tentative.add(p)
+                elif t == "fail" and p in tentative:
+                    # the release definitely didn't free: the permit
+                    # was held throughout, so restore and re-check
+                    tentative.discard(p)
+                    holds[p] = holds.get(p, 0) + 1
+                    if sum(holds.values()) > self.permits:
+                        flag(o)
+                elif t in ("ok", "info"):
+                    tentative.discard(p)
+            elif f == "acquire" and t == "ok":
+                holds[p] = holds.get(p, 0) + 1
+                if sum(holds.values()) > self.permits:
+                    flag(o)
         return {"valid?": not over, "over-capacity": over[:16]}
 
 

@@ -32,6 +32,11 @@ def _ts_str(n: int) -> str:
     return f"{n:019d}Z"
 
 
+def _row_key(r) -> str:
+    """Canonical row identity for sorting and set algebra."""
+    return json.dumps(r, sort_keys=True, default=str)
+
+
 class DB:
     """The versioned store + AST evaluator."""
 
@@ -136,10 +141,7 @@ class _Txn:
             else:
                 row = vals
             rows.append(row)
-
-        def key(r):
-            return json.dumps(r, sort_keys=True, default=str)
-        rows.sort(key=key)
+        rows.sort(key=_row_key)
         return rows
 
     # -- evaluator -----------------------------------------------------------
@@ -254,18 +256,14 @@ class _Txn:
                 raise Fault(400, "invalid expression",
                             f"{op_name} needs at least one set")
 
-            def key(r):
-                return json.dumps(r, sort_keys=True, default=str)
-
             # set semantics throughout, as real Fauna's Union/
-            # Intersection: dedupe within every argument set too
-            rows_sets = [dict.fromkeys(key(r)
-                                       for r in self._set_rows(ev(x), at))
+            # Intersection (duplicates within an argument set collapse)
+            rows_sets = [{_row_key(r)
+                          for r in self._set_rows(ev(x), at)}
                          for x in args]
-            out = set(rows_sets[0])
+            out = rows_sets[0]
             for ks in rows_sets[1:]:
-                out = out | set(ks) if op_name == "union" \
-                    else out & set(ks)
+                out = out | ks if op_name == "union" else out & ks
             return {"@rows": [json.loads(k) for k in sorted(out)]}
         if "singleton" in e:
             r = ev(e["singleton"])

@@ -59,12 +59,14 @@ def test_shim_ids_and_queue(shim):
 
 
 def test_semaphore_checker():
-    ok = [{"type": "ok", "f": "acquire", "process": 0},
-          {"type": "ok", "f": "acquire", "process": 1},
-          {"type": "ok", "f": "release", "process": 0},
-          {"type": "ok", "f": "acquire", "process": 2}]
+    def pair(f, p):
+        return [{"type": "invoke", "f": f, "process": p},
+                {"type": "ok", "f": f, "process": p}]
+
+    ok = (pair("acquire", 0) + pair("acquire", 1)
+          + pair("release", 0) + pair("acquire", 2))
     assert hazelcast.SemaphoreChecker(2).check({}, ok, {})["valid?"]
-    bad = ok[:2] + [{"type": "ok", "f": "acquire", "process": 3}]
+    bad = pair("acquire", 0) + pair("acquire", 1) + pair("acquire", 3)
     res = hazelcast.SemaphoreChecker(2).check({}, bad, {})
     assert res["valid?"] is False
     assert res["over-capacity"]
@@ -123,3 +125,65 @@ def test_hermetic_menu_run(tmp_path, shim, workload):
                                    for k, v in res.items()
                                    if isinstance(v, dict)}
     assert len(done["history"]) > 10
+
+
+def test_semaphore_checker_tolerates_release_completion_reordering():
+    """A release takes effect between its invoke and its ok: an
+    acquire granted against the freed permit may journal its ok BEFORE
+    the release's ok. That interleaving is legal and must verify."""
+    from jepsen_tpu.suites.hazelcast import SemaphoreChecker
+
+    hist = [
+        {"type": "invoke", "f": "acquire", "process": 0, "time": 0},
+        {"type": "ok", "f": "acquire", "process": 0, "time": 1},
+        {"type": "invoke", "f": "acquire", "process": 1, "time": 2},
+        {"type": "ok", "f": "acquire", "process": 1, "time": 3},
+        # p0 releases; the server frees the permit and grants p2's
+        # acquire, whose completion lands in the journal first
+        {"type": "invoke", "f": "release", "process": 0, "time": 4},
+        {"type": "invoke", "f": "acquire", "process": 2, "time": 5},
+        {"type": "ok", "f": "acquire", "process": 2, "time": 6},
+        {"type": "ok", "f": "release", "process": 0, "time": 7},
+    ]
+    res = SemaphoreChecker(2).check({}, hist, {})
+    assert res["valid?"] is True, res
+    # a genuine third concurrent holder is still flagged
+    bad = hist[:4] + [
+        {"type": "invoke", "f": "acquire", "process": 2, "time": 5},
+        {"type": "ok", "f": "acquire", "process": 2, "time": 6},
+    ]
+    res = SemaphoreChecker(2).check({}, bad, {})
+    assert res["valid?"] is False and res["over-capacity"]
+
+
+def test_semaphore_checker_counts_multi_permit_holders():
+    """One process may hold several permits (the shim's holders list
+    has one entry per acquire); a set-based checker would undercount."""
+    from jepsen_tpu.suites.hazelcast import SemaphoreChecker
+
+    def pair(f, p):
+        return [{"type": "invoke", "f": f, "process": p},
+                {"type": "ok", "f": f, "process": p}]
+
+    # p0 holds both permits, then p1's grant is a genuine violation
+    bad = pair("acquire", 0) + pair("acquire", 0) + pair("acquire", 1)
+    res = SemaphoreChecker(2).check({}, bad, {})
+    assert res["valid?"] is False and res["over-capacity"]
+
+
+def test_semaphore_checker_restores_failed_release():
+    """A failed release never freed its permit: an acquire granted
+    during the release's flight makes three certain holders."""
+    from jepsen_tpu.suites.hazelcast import SemaphoreChecker
+
+    def pair(f, p):
+        return [{"type": "invoke", "f": f, "process": p},
+                {"type": "ok", "f": f, "process": p}]
+
+    hist = (pair("acquire", 0) + pair("acquire", 1)
+            + [{"type": "invoke", "f": "release", "process": 0},
+               {"type": "invoke", "f": "acquire", "process": 2},
+               {"type": "ok", "f": "acquire", "process": 2},
+               {"type": "fail", "f": "release", "process": 0}])
+    res = SemaphoreChecker(2).check({}, hist, {})
+    assert res["valid?"] is False and res["over-capacity"], res
