@@ -40,26 +40,24 @@ def _is_append(m) -> bool:
     return m[0] == "append"
 
 
-def _is_read(m) -> bool:
-    return m[0] == "r"
-
-
 def op_internal_case(op: dict) -> dict | None:
     """A txn's reads must be consistent with its own earlier appends: a
     read of k after this txn appended vs must end with those vs in
     order."""
+    # micro-op fields accessed positionally (f, k, v = m): this loop
+    # runs once per mop over 100k-txn histories
     expected_suffix: dict[Any, list] = {}
     prev_read: dict[Any, list] = {}
     for m in op.get("value") or ():
-        k = mop.key(m)
-        if _is_append(m):
-            expected_suffix.setdefault(k, []).append(mop.value(m))
+        k = m[1]
+        if m[0] == "append":
+            expected_suffix.setdefault(k, []).append(m[2])
             if k in prev_read:
-                prev_read[k] = prev_read[k] + [mop.value(m)]
-        elif _is_read(m):
-            if mop.value(m) is None:
+                prev_read[k] = prev_read[k] + [m[2]]
+        elif m[0] == "r":
+            if m[2] is None:
                 continue  # unfilled read: no information
-            v = list(mop.value(m))
+            v = list(m[2])
             suffix = expected_suffix.get(k, [])
             if suffix and v[len(v) - len(suffix):] != suffix:
                 return {"op": op, "mop": list(m),
@@ -94,8 +92,8 @@ class _Analysis:
             if is_info(o) and not isinstance(val, (list, tuple)):
                 continue  # crashed before we knew the txn
             for m in val or ():
-                if _is_append(m):
-                    appended.setdefault(mop.key(m), []).append(mop.value(m))
+                if m[0] == "append":
+                    appended.setdefault(m[1], []).append(m[2])
             for k, vs in appended.items():
                 for i, v in enumerate(vs):
                     w = self.writer_of.setdefault(k, {})
@@ -118,9 +116,9 @@ class _Analysis:
         incompatible: list = []
         for o in self.oks:
             for m in o.get("value") or ():
-                if not _is_read(m) or mop.value(m) is None:
+                if m[0] != "r" or m[2] is None:
                     continue
-                k, v = mop.key(m), list(mop.value(m))
+                k, v = m[1], list(m[2])
                 cur = longest.get(k, [])
                 shorter, lnger = (v, cur) if len(v) <= len(cur) \
                     else (cur, v)
@@ -134,11 +132,13 @@ class _Analysis:
     def g1a_cases(self) -> list:
         """Reads observing a failed append (`aborted read`)."""
         cases = []
+        fw = self.failed_writes
         for o in self.oks:
             for m in o.get("value") or ():
-                if _is_read(m):
-                    for v in mop.value(m) or ():
-                        w = self.failed_writes.get((mop.key(m), v))
+                if m[0] == "r" and m[2]:
+                    k = m[1]
+                    for v in m[2]:
+                        w = fw.get((k, v))
                         if w is not None:
                             cases.append({"op": o, "mop": list(m),
                                           "writer": w})
@@ -150,8 +150,8 @@ class _Analysis:
         cases = []
         for o in self.oks:
             for m in o.get("value") or ():
-                if _is_read(m) and mop.value(m):
-                    k, v = mop.key(m), mop.value(m)[-1]
+                if m[0] == "r" and m[2]:
+                    k, v = m[1], m[2][-1]
                     w = self.writer_of.get(k, {}).get(v)
                     if w is not None and not w[1] and id(w[0]) != id(o):
                         cases.append({"op": o, "mop": list(m),
@@ -204,10 +204,10 @@ def graph(hist):
     for o in a.oks:
         i_reader = idx[id(o)]
         for m in o.get("value") or ():
-            if not _is_read(m) or mop.value(m) is None:
+            if m[0] != "r" or m[2] is None:
                 continue
-            k = mop.key(m)
-            vs = list(mop.value(m))
+            k = m[1]
+            vs = list(m[2])
             writers = a.writer_of.get(k, {})
             chain = orders.get(k, [])
             if vs:

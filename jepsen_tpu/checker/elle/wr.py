@@ -35,14 +35,16 @@ _INIT = object()  # the unwritten initial state (reads return None)
 
 def op_internal_case(op: dict) -> dict | None:
     """A read must agree with the txn's own latest prior op on that key."""
+    # positional micro-op access (f, k, v = m): once per mop on
+    # 10k-txn histories
     known: dict[Any, Any] = {}
     for m in op.get("value") or ():
-        k, v = mop.key(m), mop.value(m)
-        if mop.is_read(m):
+        k, v = m[1], m[2]
+        if m[0] == "r":
             if k in known and known[k] != v:
                 return {"op": op, "mop": list(m), "expected": known[k]}
             known[k] = v
-        elif mop.is_write(m):
+        elif m[0] == "w":
             known[k] = v
     return None
 
@@ -66,8 +68,8 @@ class _Analysis:
         for o in self.oks + self.infos:
             writes: dict[Any, list] = {}
             for m in o.get("value") or ():
-                if mop.is_write(m):
-                    writes.setdefault(mop.key(m), []).append(mop.value(m))
+                if m[0] == "w":
+                    writes.setdefault(m[1], []).append(m[2])
             for k, vs in writes.items():
                 for i, v in enumerate(vs):
                     if (k, v) in self.writer_of:
@@ -88,8 +90,8 @@ class _Analysis:
         for o in self.oks:
             cur: dict[Any, Any] = {}
             for m in o.get("value") or ():
-                k, v = mop.key(m), mop.value(m)
-                if mop.is_read(m):
+                k, v = m[1], m[2]
+                if m[0] == "r":
                     cur[k] = _INIT if v is None else v
                 else:
                     u = cur.get(k)
@@ -100,10 +102,11 @@ class _Analysis:
 
     def g1a_cases(self) -> list:
         cases = []
+        fw = self.failed_writes
         for o in self.oks:
             for m in o.get("value") or ():
-                if mop.is_read(m) and mop.value(m) is not None:
-                    w = self.failed_writes.get((mop.key(m), mop.value(m)))
+                if m[0] == "r" and m[2] is not None:
+                    w = fw.get((m[1], m[2]))
                     if w is not None:
                         cases.append({"op": o, "mop": list(m),
                                       "writer": w})
@@ -111,10 +114,11 @@ class _Analysis:
 
     def g1b_cases(self) -> list:
         cases = []
+        wo = self.writer_of
         for o in self.oks:
             for m in o.get("value") or ():
-                if mop.is_read(m) and mop.value(m) is not None:
-                    w = self.writer_of.get((mop.key(m), mop.value(m)))
+                if m[0] == "r" and m[2] is not None:
+                    w = wo.get((m[1], m[2]))
                     if w is not None and not w[1] and id(w[0]) != id(o):
                         cases.append({"op": o, "mop": list(m),
                                       "writer": w[0]})
