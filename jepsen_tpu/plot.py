@@ -35,6 +35,11 @@ class NoPoints(Exception):
     """Raised when a plot has no data at all (reference ::no-points)."""
 
 
+# qualitative series palette (Tol bright), cycled by per-process plots
+PALETTE = ("#4477aa", "#ee6677", "#228833", "#ccbb44",
+           "#66ccee", "#aa3377")
+
+
 @dataclass
 class Series:
     title: Optional[str]

@@ -824,6 +824,70 @@ class SequentialChecker(checker.Checker):
         return {"valid?": not errs, "non-monotonic": errs}
 
 
+def merged_windows(s: int, points: list) -> list:
+    """[lower, upper] windows of s elements around each point, with
+    overlapping windows merged (`sequential.clj:139-158`)."""
+    if not points:
+        return []
+    points = sorted(points)
+    windows = []
+    lower, upper = points[0] - s, points[0] + s
+    for p in points[1:]:
+        if upper <= p - s:
+            windows.append([lower, upper])
+            lower = p - s
+        upper = p + s
+    windows.append([lower, upper])
+    return windows
+
+
+class SequentialPlotter(checker.Checker):
+    """SVG per-process value plots around non-monotonic spots
+    (`sequential.clj:160-215`; gnuplot in the reference, our plot
+    library renders SVG into the store dir)."""
+
+    def check(self, test, hist, opts):
+        from ..checker.perf import out_path
+        from ..plot import PALETTE, Plot, Series, write as plot_write
+
+        ops = [o for o in hist
+               if o.get("type") == "ok" and o.get("value") is not None]
+        # spots: indices where a process's value went backwards
+        last: dict = {}
+        spots = []
+        for i, o in enumerate(ops):
+            p = o.get("process")
+            v = o.get("value") or 0
+            if (last.get(p) or 0) > v:
+                spots.append(i)
+            last[p] = v
+        if spots and test.get("store-dir"):
+            # per-key filenames: this runs under independent.checker,
+            # where every key shares the test's store dir
+            k = (opts or {}).get("history-key")
+            tag = "" if k is None else f"key-{k}-"
+            for wi, (lo, hi) in enumerate(merged_windows(32, spots)):
+                window = ops[max(lo, 0):min(hi + 1, len(ops))]
+                by_process: dict = {}
+                for o in window:
+                    by_process.setdefault(o.get("process"), []).append(
+                        (o.get("time", 0) / 1e9, o.get("value") or 0))
+                p = Plot(title=f"{test.get('name', '')} sequential "
+                               f"by process",
+                         ylabel="register value",
+                         series=[Series(title=str(proc), data=pts,
+                                        mode="linespoints",
+                                        color=PALETTE[i % len(PALETTE)])
+                                 for i, (proc, pts)
+                                 in enumerate(sorted(by_process.items()))])
+                try:
+                    plot_write(p, out_path(
+                        test, opts, f"sequential-{tag}{wi}.svg"))
+                except Exception:  # noqa: BLE001 — plots are best-effort
+                    pass
+        return {"valid?": True}
+
+
 def sequential_workload(opts: dict) -> dict:
     def inc_gen(test, ctx):
         return {"type": "invoke", "f": "inc",
@@ -836,6 +900,7 @@ def sequential_workload(opts: dict) -> dict:
     return {"client": SequentialClient(),
             "checker": independent.checker(checker.compose({
                 "sequential": SequentialChecker(),
+                "plot": SequentialPlotter(),
                 "timeline": timeline.html()})),
             "generator": gen.mix([inc_gen, read_gen])}
 

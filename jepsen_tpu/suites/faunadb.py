@@ -31,7 +31,7 @@ import time as _time
 from base64 import b64encode
 
 from .. import checker, cli, client as jclient, control, db as jdb
-from .. import generator as gen, independent, models, store
+from .. import generator as gen, independent, models
 from ..checker import timeline
 from ..control import util as cutil
 from ..checker.linear import linearizable
@@ -881,6 +881,8 @@ class TimestampValuePlotter(checker.Checker):
                       and (o.get("value") or [None, None])[1] is not None),
                      key=lambda o: o["value"][0])
         if ops and test.get("store-dir"):
+            from ..checker.perf import out_path
+            from ..plot import PALETTE
             by_process: dict = {}
             t0 = None
             for o in ops:
@@ -893,19 +895,16 @@ class TimestampValuePlotter(checker.Checker):
                 t0 = ts if t0 is None else t0
                 by_process.setdefault(o.get("process"), []).append(
                     (ts - t0, o["value"][1]))
-            palette = ["#4477aa", "#ee6677", "#228833", "#ccbb44",
-                       "#66ccee", "#aa3377"]
             p = Plot(title=f"{test.get('name', '')} sequential by process",
                      xlabel="faunadb timestamp", ylabel="register value",
                      series=[Series(title=str(proc), data=pts,
                                     mode="linespoints",
-                                    color=palette[i % len(palette)])
+                                    color=PALETTE[i % len(PALETTE)])
                              for i, (proc, pts)
                              in enumerate(sorted(by_process.items()))])
             try:
-                plot_write(p, store.path(
-                    test, opts.get("subdirectory", ""),
-                    "timestamp-value.svg"))
+                plot_write(p, out_path(test, opts,
+                                       "timestamp-value.svg"))
             except Exception:  # noqa: BLE001 — plotting is best-effort
                 pass
         return {"valid?": True}

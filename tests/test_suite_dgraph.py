@@ -342,3 +342,26 @@ def test_e2e_types_catches_float_coercion(tmp_path):
         assert bad["wrote"] != bad["read"]
     finally:
         f.stop()
+
+
+def test_merged_windows():
+    """`sequential.clj:139-158` window merging."""
+    assert dg.merged_windows(2, []) == []
+    assert dg.merged_windows(2, [5]) == [[3, 7]]
+    assert dg.merged_windows(2, [5, 6]) == [[3, 8]]
+    assert dg.merged_windows(2, [5, 20]) == [[3, 7], [18, 22]]
+
+
+def test_sequential_plotter_writes_svg(tmp_path):
+    """Non-monotonic spots produce windowed SVG plots in the store."""
+    hist = []
+    for i, v in enumerate([1, 2, 3, 1, 4]):   # dip at index 3
+        hist.append({"type": "ok", "f": "read", "process": 0,
+                     "value": v, "time": i * 10**9})
+    test = {"name": "seqplot", "start-time": "t0",
+            "store-dir": str(tmp_path)}
+    res = dg.SequentialPlotter().check(test, hist, {})
+    assert res["valid?"] is True
+    svgs = list((tmp_path / "seqplot" / "t0").glob("sequential-*.svg"))
+    assert svgs, "plot must be written"
+    assert "register value" in svgs[0].read_text()

@@ -410,3 +410,17 @@ def test_union_intersection_singleton(fake):
     s = c.query(q.paginate(q.singleton(q.ref("s", 12345)), size=4))
     assert s["data"] == []
     c.close()
+
+
+def test_timestamp_value_plotter_writes_svg(tmp_path):
+    """read-at histories with timestamps produce the SVG plot."""
+    hist = [{"type": "ok", "f": "read-at", "process": p,
+             "value": [f"{10 + i:019d}", i]}
+            for i, p in enumerate([0, 1, 0, 1, 0])]
+    test = {"name": "tvplot", "start-time": "t0",
+            "store-dir": str(tmp_path)}
+    res = fdb.TimestampValuePlotter().check(test, hist, {})
+    assert res["valid?"] is True
+    svg = tmp_path / "tvplot" / "t0" / "timestamp-value.svg"
+    assert svg.exists(), "plot must be written"
+    assert "register value" in svg.read_text()
