@@ -40,6 +40,15 @@ PALETTE = ("#4477aa", "#ee6677", "#228833", "#ccbb44",
            "#66ccee", "#aa3377")
 
 
+def process_series(by_process: dict) -> list:
+    """One linespoints Series per process, palette-cycled — the shared
+    shape of the per-process value plots (dgraph sequential, faunadb
+    timestamp-value)."""
+    return [Series(title=str(p), data=pts, mode="linespoints",
+                   color=PALETTE[i % len(PALETTE)])
+            for i, (p, pts) in enumerate(sorted(by_process.items()))]
+
+
 @dataclass
 class Series:
     title: Optional[str]

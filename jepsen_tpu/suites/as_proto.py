@@ -150,6 +150,9 @@ class Conn:
     def __init__(self, host: str, port: int = 3000,
                  timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
+        # request/response protocol: Nagle + delayed ACK adds ~40ms
+        # per round trip without this
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.lock = threading.Lock()
 
     def _read_exact(self, n: int) -> bytes:

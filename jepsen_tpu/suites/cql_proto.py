@@ -126,6 +126,9 @@ class Conn:
         self.timeout_s = timeout_s
         self.sock = socket.create_connection(
             (host, port), timeout=connect_timeout_s or timeout_s)
+        # request/response protocol: Nagle + delayed ACK adds ~40ms
+        # per round trip without this
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(timeout_s)
         self._stream = 0
         self._startup()

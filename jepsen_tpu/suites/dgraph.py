@@ -848,7 +848,7 @@ class SequentialPlotter(checker.Checker):
 
     def check(self, test, hist, opts):
         from ..checker.perf import out_path
-        from ..plot import PALETTE, Plot, Series, write as plot_write
+        from ..plot import Plot, process_series, write as plot_write
 
         ops = [o for o in hist
                if o.get("type") == "ok" and o.get("value") is not None]
@@ -875,11 +875,7 @@ class SequentialPlotter(checker.Checker):
                 p = Plot(title=f"{test.get('name', '')} sequential "
                                f"by process",
                          ylabel="register value",
-                         series=[Series(title=str(proc), data=pts,
-                                        mode="linespoints",
-                                        color=PALETTE[i % len(PALETTE)])
-                                 for i, (proc, pts)
-                                 in enumerate(sorted(by_process.items()))])
+                         series=process_series(by_process))
                 try:
                     plot_write(p, out_path(
                         test, opts, f"sequential-{tag}{wi}.svg"))

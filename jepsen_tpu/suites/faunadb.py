@@ -40,7 +40,7 @@ from ..nemesis import (Nemesis, compose as n_compose, f_map as n_fmap,
 from ..nemesis import partition as npart
 from ..nemesis import time as ntime
 from ..os_ import debian
-from ..plot import Plot, Series, write as plot_write
+from ..plot import Plot, write as plot_write
 from ..workloads import adya, bank as bankw
 from . import fauna_query as q
 
@@ -882,7 +882,7 @@ class TimestampValuePlotter(checker.Checker):
                      key=lambda o: o["value"][0])
         if ops and test.get("store-dir"):
             from ..checker.perf import out_path
-            from ..plot import PALETTE
+            from ..plot import process_series
             by_process: dict = {}
             t0 = None
             for o in ops:
@@ -897,11 +897,7 @@ class TimestampValuePlotter(checker.Checker):
                     (ts - t0, o["value"][1]))
             p = Plot(title=f"{test.get('name', '')} sequential by process",
                      xlabel="faunadb timestamp", ylabel="register value",
-                     series=[Series(title=str(proc), data=pts,
-                                    mode="linespoints",
-                                    color=PALETTE[i % len(PALETTE)])
-                             for i, (proc, pts)
-                             in enumerate(sorted(by_process.items()))])
+                     series=process_series(by_process))
             try:
                 plot_write(p, out_path(test, opts,
                                        "timestamp-value.svg"))

@@ -73,6 +73,9 @@ class Conn:
                  password: str = "", database: str = "",
                  timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
+        # request/response protocol: Nagle + delayed ACK adds ~40ms
+        # per round trip without this
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.seq = 0
         self._handshake(user, password, database)
 

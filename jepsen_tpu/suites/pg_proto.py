@@ -32,6 +32,9 @@ class Conn:
     def __init__(self, host: str, port: int = 26257, user: str = "root",
                  database: str = "", timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
+        # request/response protocol: Nagle + delayed ACK adds ~40ms
+        # per round trip without this
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.txn_status = "I"
         params = ["user", user]
         if database:

@@ -20,6 +20,9 @@ class RESPError(Exception):
 class Conn:
     def __init__(self, host: str, port: int, timeout_s: float = 5.0):
         self.sock = socket.create_connection((host, port), timeout_s)
+        # request/response protocol: Nagle + delayed ACK adds ~40ms
+        # per round trip without this
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.buf = b""
 
     def _line(self) -> bytes:

@@ -131,6 +131,9 @@ class ZooKeeper:
     def __init__(self, host: str, port: int = 2181,
                  timeout: float = 5.0, session_timeout_ms: int = 10_000):
         self.sock = socket.create_connection((host, port), timeout)
+        # request/response protocol: Nagle + delayed ACK adds ~40ms
+        # per round trip without this
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(timeout)
         self.xid = 0
         self._handshake(session_timeout_ms)

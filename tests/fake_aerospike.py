@@ -49,6 +49,9 @@ class FakeAerospike:
                 conn, _ = self.srv.accept()
             except OSError:
                 return
+            # request/response protocol: Nagle + delayed ACK cost
+            # ~40ms per round trip without this
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self.lock:
                 if not self.running:
                     conn.close()

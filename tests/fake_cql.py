@@ -12,6 +12,7 @@ IF conditions, and transaction batches.
 from __future__ import annotations
 
 import re
+import socket
 import socketserver
 import struct
 import threading
@@ -249,6 +250,13 @@ def _encode_value(tid: int, v) -> bytes:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # strict request/response over loopback: without
+        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
+        # round trip
+        self.request.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+
     def _recv_exact(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:

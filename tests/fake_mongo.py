@@ -5,6 +5,7 @@ collections with a global lock."""
 
 from __future__ import annotations
 
+import socket
 import socketserver
 import struct
 import threading
@@ -19,6 +20,13 @@ OP_MSG = 2013
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # strict request/response over loopback: without
+        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
+        # round trip
+        self.request.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+
     def _read_exact(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:

@@ -6,6 +6,7 @@ protocol framing, while the data layer stays hermetic and serializable.
 
 from __future__ import annotations
 
+import socket
 import socketserver
 import struct
 import threading
@@ -32,6 +33,13 @@ def _lenenc_str(b: bytes) -> bytes:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # strict request/response over loopback: without
+        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
+        # round trip
+        self.request.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+
     def _send(self, payload: bytes):
         head = len(payload).to_bytes(3, "little") + bytes([self.seq])
         self.request.sendall(head + payload)

@@ -6,6 +6,7 @@ auth, hermetic serializable data layer.
 
 from __future__ import annotations
 
+import socket
 import socketserver
 import struct
 import threading
@@ -18,6 +19,13 @@ def _msg(typ: bytes, body: bytes) -> bytes:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # strict request/response over loopback: without
+        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
+        # round trip
+        self.request.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+
     def _recv_exact(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import socket
 import socketserver
 import threading
 
@@ -24,6 +25,13 @@ def _encode(v) -> bytes:
 
 
 class _RESPHandler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # strict request/response over loopback: without
+        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
+        # round trip
+        self.request.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+
     def handle(self):
         buf = b""
         while True:

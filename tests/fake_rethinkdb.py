@@ -38,6 +38,9 @@ class FakeRethinkDB:
                 conn, _ = self.srv.accept()
             except OSError:
                 return
+            # request/response protocol: Nagle + delayed ACK cost
+            # ~40ms per round trip without this
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 

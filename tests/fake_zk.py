@@ -5,6 +5,7 @@ jute client over actual TCP."""
 
 from __future__ import annotations
 
+import socket
 import socketserver
 import struct
 import threading
@@ -71,6 +72,13 @@ class FakeZk:
         fake = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                # strict request/response over loopback: without
+                # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
+                # round trip
+                self.request.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
             def _recv_n(self, n):
                 out = b""
                 while len(out) < n:

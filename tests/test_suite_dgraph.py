@@ -365,3 +365,16 @@ def test_sequential_plotter_writes_svg(tmp_path):
     svgs = list((tmp_path / "seqplot" / "t0").glob("sequential-*.svg"))
     assert svgs, "plot must be written"
     assert "register value" in svgs[0].read_text()
+
+
+def test_all_tests_sweep_builds():
+    """The test-all sweep builds every standard workload x nemesis
+    combo without errors, excluding types (`core.clj:215-231`)."""
+    tests = list(dg._all_tests({
+        "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+        "ssh": {"dummy": True}, "time-limit": 1}))
+    assert len(tests) == (len(dg.STANDARD_NEMESES)
+                          * len(dg.STANDARD_WORKLOADS))
+    names = {t["name"] for t in tests}
+    assert "dgraph bank" in names
+    assert all("types" not in n for n in names)
