@@ -20,6 +20,8 @@ identical.
 from __future__ import annotations
 
 import socket
+
+from .netutil import nodelay
 import struct
 import threading
 
@@ -150,9 +152,7 @@ class Conn:
     def __init__(self, host: str, port: int = 3000,
                  timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.lock = threading.Lock()
 
     def _read_exact(self, n: int) -> bytes:

@@ -11,6 +11,8 @@ call. Commands raise MongoError on {'ok': 0} replies.
 from __future__ import annotations
 
 import socket
+
+from .netutil import nodelay
 import struct
 import threading
 
@@ -112,9 +114,7 @@ class Conn:
     def __init__(self, host: str, port: int = 27017,
                  timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.req_id = 0
         self.lock = threading.Lock()
 

@@ -19,6 +19,8 @@ need them.
 from __future__ import annotations
 
 import socket
+
+from .netutil import nodelay
 import struct
 
 # request/response opcodes (protocol spec §2.4)
@@ -126,9 +128,7 @@ class Conn:
         self.timeout_s = timeout_s
         self.sock = socket.create_connection(
             (host, port), timeout=connect_timeout_s or timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.sock.settimeout(timeout_s)
         self._stream = 0
         self._startup()

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import hashlib
 import socket
+
+from .netutil import nodelay
 import struct
 
 CLIENT_PROTOCOL_41 = 0x0200
@@ -73,9 +75,7 @@ class Conn:
                  password: str = "", database: str = "",
                  timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.seq = 0
         self._handshake(user, password, database)
 

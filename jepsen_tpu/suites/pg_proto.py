@@ -13,6 +13,8 @@ serialization conflicts, which CockroachDB asks clients to retry).
 from __future__ import annotations
 
 import socket
+
+from .netutil import nodelay
 import struct
 
 
@@ -32,9 +34,7 @@ class Conn:
     def __init__(self, host: str, port: int = 26257, user: str = "root",
                  database: str = "", timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.txn_status = "I"
         params = ["user", user]
         if database:

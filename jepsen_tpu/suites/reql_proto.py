@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import json
 import socket
+
+from .netutil import nodelay
 import struct
 import threading
 
@@ -137,9 +139,7 @@ class Conn:
     def __init__(self, host: str, port: int = 28015,
                  auth_key: str = "", timeout_s: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.token = 0
         self.lock = threading.Lock()
         key = auth_key.encode()

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import socket
 
+from .netutil import nodelay
+
 
 class RESPError(Exception):
     pass
@@ -20,9 +22,7 @@ class RESPError(Exception):
 class Conn:
     def __init__(self, host: str, port: int, timeout_s: float = 5.0):
         self.sock = socket.create_connection((host, port), timeout_s)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.buf = b""
 
     def _line(self) -> bytes:

@@ -14,6 +14,8 @@ buffer as int32 length + bytes (-1 = null), string as UTF-8 buffer.
 from __future__ import annotations
 
 import socket
+
+from .netutil import nodelay
 import struct
 from dataclasses import dataclass
 from typing import Optional
@@ -131,9 +133,7 @@ class ZooKeeper:
     def __init__(self, host: str, port: int = 2181,
                  timeout: float = 5.0, session_timeout_ms: int = 10_000):
         self.sock = socket.create_connection((host, port), timeout)
-        # request/response protocol: Nagle + delayed ACK adds ~40ms
-        # per round trip without this
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        nodelay(self.sock)
         self.sock.settimeout(timeout)
         self.xid = 0
         self._handshake(session_timeout_ms)

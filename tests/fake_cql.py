@@ -14,6 +14,8 @@ from __future__ import annotations
 import re
 import socket
 import socketserver
+
+from netutil import NodelayHandler
 import struct
 import threading
 
@@ -249,13 +251,7 @@ def _encode_value(tid: int, v) -> bytes:
     return struct.pack("!i", len(b)) + b
 
 
-class _Handler(socketserver.BaseRequestHandler):
-    def setup(self):
-        # strict request/response over loopback: without
-        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
-        # round trip
-        self.request.setsockopt(socket.IPPROTO_TCP,
-                                socket.TCP_NODELAY, 1)
+class _Handler(NodelayHandler):
 
     def _recv_exact(self, n: int) -> bytes:
         buf = b""

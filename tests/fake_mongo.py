@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import socket
 import socketserver
+
+from netutil import NodelayHandler
 import struct
 import threading
 
@@ -19,13 +21,19 @@ from jepsen_tpu.suites.bson_proto import decode_doc, encode_doc  # noqa: E402
 OP_MSG = 2013
 
 
-class _Handler(socketserver.BaseRequestHandler):
+class _Handler(NodelayHandler):
     def setup(self):
-        # strict request/response over loopback: without
-        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
-        # round trip
-        self.request.setsockopt(socket.IPPROTO_TCP,
-                                socket.TCP_NODELAY, 1)
+        super().setup()
+        # registered so stop() can kill live sessions (tests rely on
+        # in-flight clients observing server death); the stopped flag
+        # is checked under the same lock stop() drains with, so a
+        # connection accepted during shutdown can't escape the close
+        srv: "FakeMongo" = self.server  # type: ignore[assignment]
+        with srv.lock:
+            if srv._stopped:
+                self.request.close()
+                return
+            srv._conns.append(self.request)
 
     def _read_exact(self, n: int) -> bytes:
         buf = b""
@@ -69,19 +77,31 @@ class FakeMongo(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     def __init__(self):
-        super().__init__(("127.0.0.1", 0), _Handler)
         self.colls: dict = {}
         self.lock = threading.Lock()
+        self._conns: list = []
+        self._stopped = False
         self.fail_hook = None  # fail_hook(cmd) -> (code, msg) | None
         self.initiated = False
+        super().__init__(("127.0.0.1", 0), _Handler)
         self.port = self.server_address[1]
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
         self._thread.start()
 
     def stop(self):
+        """Close the listener AND every accepted session socket, so
+        in-flight clients deterministically see the server die."""
         self.shutdown()
         self.server_close()
+        with self.lock:
+            self._stopped = True
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _coll(self, cmd, name) -> list:
         return self.colls.setdefault((cmd["$db"], name), [])

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import socket
 import socketserver
+
+from netutil import NodelayHandler
 import struct
 import threading
 
@@ -32,13 +34,7 @@ def _lenenc_str(b: bytes) -> bytes:
     return _lenenc(len(b)) + b
 
 
-class _Handler(socketserver.BaseRequestHandler):
-    def setup(self):
-        # strict request/response over loopback: without
-        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
-        # round trip
-        self.request.setsockopt(socket.IPPROTO_TCP,
-                                socket.TCP_NODELAY, 1)
+class _Handler(NodelayHandler):
 
     def _send(self, payload: bytes):
         head = len(payload).to_bytes(3, "little") + bytes([self.seq])

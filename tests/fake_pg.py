@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import socket
 import socketserver
+
+from netutil import NodelayHandler
 import struct
 import threading
 
@@ -18,13 +20,7 @@ def _msg(typ: bytes, body: bytes) -> bytes:
     return typ + struct.pack("!I", len(body) + 4) + body
 
 
-class _Handler(socketserver.BaseRequestHandler):
-    def setup(self):
-        # strict request/response over loopback: without
-        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
-        # round trip
-        self.request.setsockopt(socket.IPPROTO_TCP,
-                                socket.TCP_NODELAY, 1)
+class _Handler(NodelayHandler):
 
     def _recv_exact(self, n: int) -> bytes:
         buf = b""

@@ -8,6 +8,8 @@ import collections
 import itertools
 import socket
 import socketserver
+
+from netutil import NodelayHandler
 import threading
 
 
@@ -24,13 +26,7 @@ def _encode(v) -> bytes:
     return b"$%d\r\n%s\r\n" % (len(b), b)
 
 
-class _RESPHandler(socketserver.BaseRequestHandler):
-    def setup(self):
-        # strict request/response over loopback: without
-        # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
-        # round trip
-        self.request.setsockopt(socket.IPPROTO_TCP,
-                                socket.TCP_NODELAY, 1)
+class _RESPHandler(NodelayHandler):
 
     def handle(self):
         buf = b""

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import socket
 import socketserver
+
+from netutil import NodelayHandler
 import struct
 import threading
 
@@ -71,13 +73,7 @@ class FakeZk:
     def start(self) -> int:
         fake = self
 
-        class Handler(socketserver.BaseRequestHandler):
-            def setup(self):
-                # strict request/response over loopback: without
-                # TCP_NODELAY, Nagle + delayed ACK cost ~40ms per
-                # round trip
-                self.request.setsockopt(
-                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        class Handler(NodelayHandler):
 
             def _recv_n(self, n):
                 out = b""
