@@ -12,7 +12,6 @@ IF conditions, and transaction batches.
 from __future__ import annotations
 
 import re
-import socket
 import socketserver
 
 from netutil import NodelayHandler

@@ -5,7 +5,6 @@ collections with a global lock."""
 
 from __future__ import annotations
 
-import socket
 import socketserver
 
 from netutil import NodelayHandler

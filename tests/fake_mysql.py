@@ -6,7 +6,6 @@ protocol framing, while the data layer stays hermetic and serializable.
 
 from __future__ import annotations
 
-import socket
 import socketserver
 
 from netutil import NodelayHandler

@@ -6,7 +6,6 @@ auth, hermetic serializable data layer.
 
 from __future__ import annotations
 
-import socket
 import socketserver
 
 from netutil import NodelayHandler

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import collections
 import itertools
-import socket
 import socketserver
 
 from netutil import NodelayHandler

@@ -5,7 +5,6 @@ jute client over actual TCP."""
 
 from __future__ import annotations
 
-import socket
 import socketserver
 
 from netutil import NodelayHandler
