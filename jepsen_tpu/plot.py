@@ -40,6 +40,43 @@ PALETTE = ("#4477aa", "#ee6677", "#228833", "#ccbb44",
            "#66ccee", "#aa3377")
 
 
+def merged_windows(s: int, points: list) -> list:
+    """[lower, upper] windows of s elements around each point, with
+    overlapping windows merged (the reference's merged-windows,
+    `sequential.clj:139-158` / `monotonic.clj:242-263`; touching
+    windows stay separate, as there)."""
+    if not points:
+        return []
+    points = sorted(points)
+    windows = []
+    lower, upper = points[0] - s, points[0] + s
+    for p in points[1:]:
+        if upper <= p - s:
+            windows.append([lower, upper])
+            lower = p - s
+        upper = p + s
+    windows.append([lower, upper])
+    return windows
+
+
+def regression_spots(pairs: list, global_too: bool = False) -> list:
+    """Indices where a value regresses, given (process, value) pairs in
+    plot order: per-process decreases, plus — when global_too —
+    decreases between consecutive pairs regardless of process (the two
+    anomaly shapes the sequential/timestamp-value checkers flag)."""
+    last: dict = {}
+    prev = None
+    spots = []
+    for i, (p, v) in enumerate(pairs):
+        pv = last.get(p)
+        if (pv is not None and v < pv) or \
+                (global_too and prev is not None and v < prev):
+            spots.append(i)
+        last[p] = v
+        prev = v
+    return spots
+
+
 def process_series(by_process: dict) -> list:
     """One linespoints Series per process, palette-cycled — the shared
     shape of the per-process value plots (dgraph sequential, faunadb

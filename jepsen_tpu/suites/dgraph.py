@@ -41,6 +41,7 @@ from ..nemesis import combined as ncomb
 from ..nemesis import partition as npart
 from ..nemesis import time as ntime
 from ..os_ import debian
+from ..plot import merged_windows  # window algebra for spot plots
 from ..workloads import linearizable_register as lr
 from ..workloads import long_fork, wr as wrw
 
@@ -824,21 +825,6 @@ class SequentialChecker(checker.Checker):
         return {"valid?": not errs, "non-monotonic": errs}
 
 
-def merged_windows(s: int, points: list) -> list:
-    """[lower, upper] windows of s elements around each point, with
-    overlapping windows merged (`sequential.clj:139-158`)."""
-    if not points:
-        return []
-    points = sorted(points)
-    windows = []
-    lower, upper = points[0] - s, points[0] + s
-    for p in points[1:]:
-        if upper <= p - s:
-            windows.append([lower, upper])
-            lower = p - s
-        upper = p + s
-    windows.append([lower, upper])
-    return windows
 
 
 class SequentialPlotter(checker.Checker):
@@ -848,19 +834,14 @@ class SequentialPlotter(checker.Checker):
 
     def check(self, test, hist, opts):
         from ..checker.perf import out_path
-        from ..plot import Plot, process_series, write as plot_write
+        from ..plot import (Plot, process_series, regression_spots,
+                            write as plot_write)
 
         ops = [o for o in hist
                if o.get("type") == "ok" and o.get("value") is not None]
-        # spots: indices where a process's value went backwards
-        last: dict = {}
-        spots = []
-        for i, o in enumerate(ops):
-            p = o.get("process")
-            v = o.get("value") or 0
-            if (last.get(p) or 0) > v:
-                spots.append(i)
-            last[p] = v
+        # spots mirror SequentialChecker: per-process regressions
+        spots = regression_spots(
+            [(o.get("process"), o.get("value") or 0) for o in ops])
         if spots and test.get("store-dir"):
             # per-key filenames: this runs under independent.checker,
             # where every key shares the test's store dir

@@ -871,21 +871,36 @@ class TimestampValueChecker(checker.Checker):
 
 
 class TimestampValuePlotter(checker.Checker):
-    """SVG scatter of register value against Fauna timestamp around
-    non-monotonic spots (`monotonic.clj:218-300`, gnuplot in the
-    reference; our plot library renders SVG)."""
+    """SVG scatter of register value against Fauna timestamp, windowed
+    around non-monotonic spots (`monotonic.clj:218-300`: spots ->
+    merged +/-32 windows -> one plot each; gnuplot in the reference,
+    our plot library renders SVG)."""
 
     def check(self, test, hist, opts):
         ops = sorted((o for o in hist
                       if o.get("type") == "ok" and o.get("f") == "read-at"
                       and (o.get("value") or [None, None])[1] is not None),
                      key=lambda o: o["value"][0])
-        if ops and test.get("store-dir"):
-            from ..checker.perf import out_path
-            from ..plot import process_series
+        if not ops or not test.get("store-dir"):
+            return {"valid?": True}
+        from ..checker.perf import out_path
+        from ..plot import merged_windows, process_series, \
+            regression_spots
+        # spots in timestamp order: per-process regressions (the
+        # reference plotter's shape) PLUS global consecutive decreases
+        # (what TimestampValueChecker flags), so every checker-cited
+        # anomaly lands inside a plotted window
+        spots = regression_spots(
+            [(o.get("process"), o["value"][1]) for o in ops],
+            global_too=True)
+        # nothing anomalous: plot everything once (the reference emits
+        # no plot at all; one overview costs little and helps triage)
+        windows = merged_windows(32, spots) or [[0, len(ops)]]
+        for wi, (lo, hi) in enumerate(windows):
+            window = ops[max(lo, 0):min(hi + 1, len(ops))]
             by_process: dict = {}
             t0 = None
-            for o in ops:
+            for o in window:
                 try:
                     ts = float(o["value"][0].replace("T", " ")
                                .replace("-", "").replace(":", "")
@@ -895,12 +910,13 @@ class TimestampValuePlotter(checker.Checker):
                 t0 = ts if t0 is None else t0
                 by_process.setdefault(o.get("process"), []).append(
                     (ts - t0, o["value"][1]))
-            p = Plot(title=f"{test.get('name', '')} sequential by process",
+            p = Plot(title=f"{test.get('name', '')} timestamp-value "
+                           f"by process",
                      xlabel="faunadb timestamp", ylabel="register value",
                      series=process_series(by_process))
             try:
-                plot_write(p, out_path(test, opts,
-                                       "timestamp-value.svg"))
+                plot_write(p, out_path(
+                    test, opts, f"timestamp-value-{wi}.svg"))
             except Exception:  # noqa: BLE001 — plotting is best-effort
                 pass
         return {"valid?": True}
