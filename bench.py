@@ -30,6 +30,10 @@ import json
 import sys
 import time
 
+from jepsen_tpu._platform import honor_cpu_env
+
+honor_cpu_env()
+
 
 def _note(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)

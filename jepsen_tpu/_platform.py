@@ -1,0 +1,18 @@
+"""Platform plumbing for driver entry scripts.
+
+Some interpreters pre-import jax via sitecustomize and bake a real-TPU
+platform into the live config, overriding a JAX_PLATFORMS=cpu set by
+the caller; `honor_cpu_env()` re-asserts the caller's choice so CPU
+dry-runs and smoke runs stay hermetic. (The test conftest goes further
+and forces CPU unconditionally.)"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_env() -> None:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
