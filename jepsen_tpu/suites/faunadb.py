@@ -592,7 +592,10 @@ class SetClient(_FaunaClient):
 def set_workload(opts: dict) -> dict:
     adds = gen.IterGen({"type": "invoke", "f": "add", "value": v}
                        for v in itertools.count())
-    reads = {"type": "invoke", "f": "read", "value": None}
+    def reads(test, ctx):
+        # fn gen: a bare dict is one-shot, capping the run at 1 read
+        return {"type": "invoke", "f": "read", "value": None}
+
     return {
         "client": SetClient(),
         # reads deliberately starve writes (`set.clj:76-79`)
@@ -1337,8 +1340,10 @@ def internal_workload(opts: dict) -> dict:
     return {
         "client": InternalClient(),
         "generator": gen.stagger(1 / 10, gen.mix([
-            {"type": "invoke", "f": "reset", "value": None},
-            {"type": "invoke", "f": "change-type", "value": None},
+            lambda test, ctx: {"type": "invoke", "f": "reset",
+                               "value": None},
+            lambda test, ctx: {"type": "invoke", "f": "change-type",
+                               "value": None},
             creator("create-tabby-let"),
             creator("create-tabby-obj"),
             creator("create-tabby-arr")])),
