@@ -1,0 +1,16 @@
+# CI gate (the reference gates pushes on lint + unit tests,
+# `.travis.yml:1-11`: lein eastwood + lein test).  `make check` is the
+# one command to run before pushing.
+
+PY ?= python
+
+.PHONY: lint test check
+
+lint:
+	$(PY) tools/lint.py
+	$(PY) -m compileall -q jepsen_tpu tests tools bench.py __graft_entry__.py
+
+test:
+	$(PY) -m pytest tests/ -q
+
+check: lint test

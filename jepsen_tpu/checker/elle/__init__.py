@@ -18,7 +18,7 @@ to G-single+G2-item (matching `tests/cycle/wr.clj:31-45`'s taxonomy).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Iterable
+from typing import Iterable
 
 from .. import Checker
 from . import kernels, list_append, wr  # noqa: F401

@@ -23,11 +23,10 @@ the enumerable-state models at scale; `linearizable()` dispatches.
 from __future__ import annotations
 
 import time as _time
-from typing import Any
 
 from .. import models as m
 from ..history import DeviceEncodingError, History, \
-    history as as_history, is_fail, is_info, is_invoke, is_ok
+    history as as_history, is_fail, is_info, is_invoke
 from . import Checker, UNKNOWN
 
 

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 from html import escape
 
-from .. import store, util
-from ..history import NEMESIS, history, is_invoke
+from .. import util
+from ..history import NEMESIS, history
 from . import Checker
 
 OP_LIMIT = 10_000  # render cap for massive histories (`timeline.clj:12-14`)

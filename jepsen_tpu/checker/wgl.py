@@ -53,13 +53,12 @@ import dataclasses
 import functools
 import heapq
 import time as _time
-from typing import Any, Callable
+from typing import Callable
 
 import numpy as np
 
 from ..history import (DeviceEncodingError, F_CAS, F_READ, F_WRITE,
-                       KIND_OK, NIL, OpArray,
-                       PENDING_RET, History, default_register_codec,
+                       KIND_OK, NIL, OpArray, default_register_codec,
                        encode_ops, history as as_history)
 
 # Event kinds (host-side stream construction)
@@ -1289,7 +1288,7 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, PartitionSpec as P
 
     name = model.device_model
     if mesh is None:

@@ -22,7 +22,7 @@ import pprint as _pprint
 import re
 import sys
 import time as _time
-from typing import Any, Callable, Optional
+from typing import Optional
 
 log = logging.getLogger(__name__)
 
@@ -188,10 +188,17 @@ def test_opt_fn(opts: dict) -> dict:
     # hyphenated spelling throughout (a test *is* a map, keyed like the
     # reference's :some-flag keywords) — rename every remaining
     # underscore key so suite opt-specs can't silently miss
+    renamed = []
     for k in [k for k in opts if isinstance(k, str) and "_" in k]:
         hy = k.replace("_", "-")
         if hy not in opts:
             opts[hy] = opts.pop(k)
+            renamed.append(k)
+    if renamed:
+        # visible at debug level so an opt_fn that deliberately reads
+        # an underscore key can see why it stopped matching
+        log.debug("renamed underscore option keys to hyphenated: %s",
+                  sorted(renamed))
     return opts
 
 

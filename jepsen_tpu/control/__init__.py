@@ -26,7 +26,7 @@ from typing import Any, Callable, Iterable
 from ..util import real_pmap
 from . import dummy as dummy_mod
 from . import ssh as ssh_mod
-from .core import (Literal, Remote, RemoteError, env, escape, lit,
+from .core import (Literal, Remote, RemoteError, escape, lit,
                    throw_on_nonzero_exit)
 
 log = logging.getLogger(__name__)

@@ -23,7 +23,7 @@ returns the action plus ``{"exit": int, "out": str, "err": str}``.
 from __future__ import annotations
 
 import re
-from typing import Any, Iterable, Mapping, Sequence
+from typing import Any, Mapping
 
 
 class Remote:

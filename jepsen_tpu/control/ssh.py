@@ -19,7 +19,6 @@ cheap mux client, not a fresh handshake.
 
 from __future__ import annotations
 
-import os
 import shutil
 import subprocess
 import tempfile

@@ -15,7 +15,7 @@ import os.path
 import random
 
 from .. import util
-from . import cmd_context, exec_, exec_raw, ssh_star, var
+from . import exec_, ssh_star, var
 from .core import RemoteError, env as make_env, escape, lit, \
     throw_on_nonzero_exit
 from . import cd

@@ -18,7 +18,6 @@ import time as _time
 
 from . import control
 from .control import util as cu
-from .control.core import RemoteError
 
 log = logging.getLogger(__name__)
 

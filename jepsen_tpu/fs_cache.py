@@ -17,7 +17,7 @@ import os
 import re
 import tempfile
 import threading
-from typing import Any, Iterable
+from typing import Any
 
 DEFAULT_DIR = "/tmp/jepsen/cache"
 

@@ -25,7 +25,7 @@ import logging
 import queue
 import threading
 import time as _time
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as jclient
 from ..history import History

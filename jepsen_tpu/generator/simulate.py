@@ -38,13 +38,20 @@ def invocations(history: list) -> list:
 
 
 def simulate(ctx_or_gen, gen_or_complete, complete: Optional[Callable]
-             = None, seed: int = RAND_SEED) -> list:
+             = None, seed: int = RAND_SEED, test: dict = DEFAULT_TEST,
+             max_ops: Optional[int] = None) -> list:
     """simulate([ctx,] gen, complete_fn) -> full history.
 
     Single-threaded discrete-event loop: take the generator's next
     invocation if it precedes every in-flight completion; otherwise apply
     the earliest completion first (freeing its thread, retiring crashed
-    processes). Deterministic under the fixed seed.
+    processes). Deterministic under the fixed seed. `test` is the test
+    map handed to fn-generators; defaults to {} but suite-level
+    simulations pass the real test map so generators that read test keys
+    (nodes, concurrency, workload opts) behave as they would live.
+    `max_ops` bounds the history: a generator whose state machine needs
+    live client/nemesis side effects to advance (which a simulation
+    cannot provide) would otherwise spin at a frozen virtual time.
     """
     if complete is None:
         ctx, gen, complete = default_context(), ctx_or_gen, gen_or_complete
@@ -55,11 +62,20 @@ def simulate(ctx_or_gen, gen_or_complete, complete: Optional[Callable]
         ops: list = []
         in_flight: list = []  # completions, kept sorted by time
         gen = validate(gen)
+        def _finish():
+            # in-flight sleeps/wakes stay out of the history, same as
+            # the completion branch below and the interpreter's
+            # goes_in_history()
+            ops.extend(o for o in in_flight
+                       if o.get("type") not in ("sleep", "log"))
+            return ops
+
         while True:
-            res = gen_op(gen, DEFAULT_TEST, ctx)
+            if max_ops is not None and len(ops) >= max_ops:
+                return _finish()
+            res = gen_op(gen, test, ctx)
             if res is None:
-                ops.extend(in_flight)
-                return ops
+                return _finish()
             invoke, gen1 = res
             if invoke is not PENDING and (
                     not in_flight
@@ -68,11 +84,21 @@ def simulate(ctx_or_gen, gen_or_complete, complete: Optional[Callable]
                 thread = process_to_thread(ctx, invoke["process"])
                 ctx = ctx.with_time(max(ctx.time, invoke["time"]))
                 ctx = ctx.busy(thread)
-                gen = gen_update(gen1, DEFAULT_TEST, ctx, invoke)
-                comp = complete(ctx, invoke)
+                gen = gen_update(gen1, test, ctx, invoke)
+                if invoke.get("type") == "sleep":
+                    # mirror the interpreter (`interpreter.py:141-143`):
+                    # the thread wakes value seconds later; sleeps stay
+                    # out of the history
+                    comp = dict(invoke)
+                    comp["time"] = invoke["time"] + int(
+                        invoke["value"] * 1e9)
+                elif invoke.get("type") == "log":
+                    comp = dict(invoke)
+                else:
+                    comp = complete(ctx, invoke)
+                    ops.append(invoke)
                 in_flight.append(comp)
                 in_flight.sort(key=lambda o: o["time"])
-                ops.append(invoke)
             else:
                 # must complete something first
                 assert in_flight, \
@@ -81,12 +107,13 @@ def simulate(ctx_or_gen, gen_or_complete, complete: Optional[Callable]
                 thread = process_to_thread(ctx, comp["process"])
                 ctx = ctx.with_time(max(ctx.time, comp["time"]))
                 ctx = ctx.free(thread)
-                gen = gen_update(gen, DEFAULT_TEST, ctx, comp)
+                gen = gen_update(gen, test, ctx, comp)
                 if thread != NEMESIS and comp.get("type") == "info":
                     workers = dict(ctx.workers)
                     workers[thread] = next_process(ctx, thread)
                     ctx = ctx.with_workers(workers)
-                ops.append(comp)
+                if comp.get("type") not in ("sleep", "log"):
+                    ops.append(comp)
 
 
 def _ok(ctx, invoke):
@@ -95,10 +122,11 @@ def _ok(ctx, invoke):
     return out
 
 
-def quick_ops(ctx_or_gen, gen=None) -> list:
+def quick_ops(ctx_or_gen, gen=None, test: dict = DEFAULT_TEST,
+              max_ops: Optional[int] = None) -> list:
     if gen is None:
         ctx_or_gen, gen = default_context(), ctx_or_gen
-    return simulate(ctx_or_gen, gen, _ok)
+    return simulate(ctx_or_gen, gen, _ok, test=test, max_ops=max_ops)
 
 
 def quick(ctx_or_gen, gen=None) -> list:

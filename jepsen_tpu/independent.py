@@ -18,11 +18,11 @@ threads (`independent.clj:266+`).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Iterable, Sequence
+from typing import Any, Callable, Iterable
 
 from . import generator as gen
-from .checker import Checker, UNKNOWN, check_safe, coerce, merge_valid
-from .generator import Context, Gen, PENDING
+from .checker import Checker, check_safe, coerce, merge_valid
+from .generator import Gen, PENDING
 from .history import History, history as as_history
 from .util import bounded_pmap
 

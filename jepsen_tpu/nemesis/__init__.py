@@ -10,7 +10,7 @@ composition.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable
+from typing import Callable
 
 
 class Nemesis:

@@ -12,7 +12,6 @@ A *grudge* maps each node to the set of nodes whose traffic it drops.
 
 from __future__ import annotations
 
-import math
 import random
 from typing import Callable, Iterable
 

@@ -12,10 +12,8 @@ A *grudge* is {node: set-of-nodes-to-drop-traffic-from}.
 from __future__ import annotations
 
 import threading
-from typing import Iterable
 
 from . import control as c
-from .control import util as cu
 from .control.core import RemoteError, lit
 from .util import real_pmap
 

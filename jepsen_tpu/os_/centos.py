@@ -13,7 +13,6 @@ import re
 
 from .. import control as c
 from ..control import util as cu
-from ..control.core import RemoteError
 from . import OS
 
 log = logging.getLogger(__name__)

@@ -13,7 +13,7 @@ import time as _time
 
 from .. import control as c
 from ..control import util as cu
-from ..control.core import RemoteError, lit
+from ..control.core import lit
 from . import OS
 
 log = logging.getLogger(__name__)

@@ -41,9 +41,8 @@ from ..control.core import RemoteError
 from ..nemesis import partition as npartition, time as ntime
 from . import std_opts, std_test
 from .as_proto import (ASError, Conn, RC_GENERATION, RC_FORBIDDEN,
-                       RC_HOT_KEY, RC_KEY_NOT_FOUND,
-                       RC_PARTITION_UNAVAILABLE,
-                       RC_SERVER_NOT_AVAILABLE)
+                       RC_HOT_KEY,
+                       RC_PARTITION_UNAVAILABLE)
 
 log = logging.getLogger(__name__)
 

@@ -19,6 +19,7 @@ need them.
 from __future__ import annotations
 
 import socket
+import time as _time
 
 from .netutil import nodelay
 import struct
@@ -162,7 +163,11 @@ class Conn:
         that prepend sections to the body (tracing 0x02, custom
         payload 0x04, warning 0x08) are stripped so result offsets
         stay correct."""
-        for _ in range(32):  # bounded: a stale backlog can't spin forever
+        # time-bounded drain: a long stale backlog must not turn a
+        # recoverable read into connection churn, but the wait can't
+        # exceed one socket-timeout window either
+        deadline = _time.monotonic() + (self.sock.gettimeout() or 5.0)
+        while _time.monotonic() < deadline:
             hdr = self._recv_exact(9)
             _ver, flags, stream, opcode, length = struct.unpack(
                 "!BBhBI", hdr)
@@ -189,7 +194,8 @@ class Conn:
                     (vlen,) = struct.unpack("!i", body[pos:pos + 4])
                     pos += 4 + max(vlen, 0)
             return opcode, body[pos:]
-        raise ConnectionError("no frame for current stream id after 32 reads")
+        raise ConnectionError(
+            "no frame for current stream id within the timeout window")
 
     # -- handshake -----------------------------------------------------------
 

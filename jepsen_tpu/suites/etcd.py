@@ -14,7 +14,6 @@ from __future__ import annotations
 import base64
 import binascii
 import itertools
-import json
 import logging
 import urllib.error
 import urllib.request

@@ -18,7 +18,7 @@ from __future__ import annotations
 import itertools
 import logging
 
-from .. import checker, cli, client as jclient, control
+from .. import cli, client as jclient, control
 from .. import db as jdb
 from .. import generator as gen
 from ..checker import Checker

@@ -13,7 +13,6 @@ reference's in-repo `hazelcast/server/` component.
 from __future__ import annotations
 
 import itertools
-import json
 import logging
 import urllib.error
 import urllib.request

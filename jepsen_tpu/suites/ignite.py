@@ -22,10 +22,8 @@ import logging
 import urllib.parse
 import urllib.request
 
-from .. import checker, cli, client as jclient, control, independent, models
+from .. import cli, client as jclient, control, independent
 from .. import db as jdb
-from .. import generator as gen
-from ..checker import linear
 from ..control import util as cu
 from ..control.core import RemoteError
 from ..os_ import debian

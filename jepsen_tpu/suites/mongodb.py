@@ -22,7 +22,6 @@ from .. import db as jdb
 from .. import generator as gen
 from .. import independent
 from ..control import util as cu
-from ..os_ import debian
 from ..workloads import linearizable_register
 from . import std_opts, std_test
 from .bson_proto import Conn, MongoError, WriteConcernError

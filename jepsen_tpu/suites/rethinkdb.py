@@ -15,9 +15,8 @@ from __future__ import annotations
 
 import logging
 
-from .. import cli, client as jclient, control, independent, models
+from .. import cli, client as jclient, control, independent
 from .. import db as jdb
-from ..checker import linear
 from ..control import util as cu
 from ..control.core import RemoteError
 from ..os_ import debian

@@ -18,7 +18,7 @@ from .. import generator as gen
 from .. import independent
 from ..checker import Checker
 from ..history import history as as_history, is_ok
-from ..models import Inconsistent, inconsistent, is_inconsistent
+from ..models import inconsistent, is_inconsistent
 
 
 @dataclasses.dataclass(frozen=True)

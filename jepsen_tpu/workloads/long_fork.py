@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-from typing import Any
 
 from .. import generator as gen
 from .. import txn as mop

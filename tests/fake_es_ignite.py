@@ -5,7 +5,6 @@ MVCC versioned puts, flush, search) and the Ignite REST API
 from __future__ import annotations
 
 import json
-import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer

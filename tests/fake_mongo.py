@@ -28,9 +28,11 @@ class _Handler(NodelayHandler):
         # is checked under the same lock stop() drains with, so a
         # connection accepted during shutdown can't escape the close
         srv: "FakeMongo" = self.server  # type: ignore[assignment]
+        self._rejected = False
         with srv.lock:
             if srv._stopped:
                 self.request.close()
+                self._rejected = True
                 return
             srv._conns.append(self.request)
 
@@ -44,6 +46,10 @@ class _Handler(NodelayHandler):
         return buf
 
     def handle(self):
+        if self._rejected:
+            # connection was closed in setup() during shutdown; a recv
+            # here would raise and spray handle_error tracebacks
+            return
         srv: "FakeMongo" = self.server  # type: ignore[assignment]
         try:
             while True:

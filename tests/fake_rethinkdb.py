@@ -17,6 +17,9 @@ class FakeRethinkDB:
     def __init__(self):
         self.tables: dict[tuple, dict] = {}   # (db, tbl) -> {id: doc}
         self.lock = threading.Lock()
+        # corrupt_hook(term, out) -> replacement out; lets negative
+        # tests serve wrong answers without touching the store
+        self.corrupt_hook = None
         self.srv = socket.socket()
         self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.srv.bind(("127.0.0.1", 0))
@@ -69,6 +72,8 @@ class FakeRethinkDB:
                 try:
                     with self.lock:
                         out = self._eval(term, None)
+                    if self.corrupt_hook is not None:
+                        out = self.corrupt_hook(term, out)
                     resp = {"t": r.R_SUCCESS_ATOM, "r": [out]}
                 except _Abort as e:
                     resp = {"t": r.R_RUNTIME_ERROR, "r": [str(e)]}

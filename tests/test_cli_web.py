@@ -8,8 +8,8 @@ import urllib.request
 
 import pytest
 
-from jepsen_tpu import checker, cli, core, generator as gen
-from jepsen_tpu import repl, report, store, testkit, web
+from jepsen_tpu import checker, cli, generator as gen
+from jepsen_tpu import repl, report, testkit, web
 
 
 # -- option post-processing -------------------------------------------------

@@ -8,7 +8,7 @@ import pytest
 
 from jepsen_tpu import control, db, faketime
 from jepsen_tpu.control import dummy
-from jepsen_tpu.os_ import Noop as OsNoop, debian, ubuntu
+from jepsen_tpu.os_ import debian, ubuntu
 
 
 def make_test(remote, nodes=("n1", "n2", "n3")):

@@ -201,10 +201,14 @@ def test_time_limit_cuts_off():
     assert ts == [0, 1_000_000_000]
 
 
-def test_sleep_op():
-    h = sim.quick_ops(gen.once(gen.sleep(2)))
-    assert h[0]["type"] in ("sleep", "ok")
-    assert h[0]["value"] == 2
+def test_sleep_op_stays_out_of_history_but_advances_time():
+    # interpreter parity: sleeps/logs are handled in the worker and
+    # never reach the history (`interpreter.py:117,141-144`)
+    assert sim.quick_ops(gen.once(gen.sleep(2))) == []
+    h = sim.quick_ops(gen.phases(gen.once(gen.sleep(2)),
+                                 gen.once({"f": "read"})))
+    assert [o["f"] for o in h] == ["read", "read"]
+    assert h[0]["time"] >= 2_000_000_000
 
 
 # -- phasing -----------------------------------------------------------------

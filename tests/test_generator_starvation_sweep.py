@@ -1,0 +1,132 @@
+"""Framework-wide sweep for the one-shot-generator starvation class.
+
+A bare op dict in a `gen.mix` is one-shot: once drawn, it is exhausted,
+so a workload menu built from bare dicts caps the run at ~#dicts ops and
+can leave an op class with a single lone invocation (the stats checker's
+zero-ok starvation signature — fixed by hand for yugabyte/faunadb in
+round 4: cc092e9, 5442f2a).  The reference never has this problem
+because its fn generators recur for the whole run
+(`jepsen/src/jepsen/generator.clj:545-590`).
+
+This sweep guards the whole catalog.  For every suite workload menu it
+builds the real test map twice — once with a short time limit, once 3x
+longer — and runs each generator through the deterministic simulator on
+virtual time:
+
+  * op volume must scale with the time limit (a one-shot mix plateaus
+    at ~#dicts ops regardless of the limit — the ~52-op cap the round-4
+    fix names);
+  * every op class must recur (>1 invocation) — unless its single op
+    sits in the history's tail, where deliberate once-per-run final
+    reads land (the lone-op starvation signature strikes mid-run).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import simulate
+from jepsen_tpu.suites import SUITES, suite as load_suite
+
+RATE = 50.0
+SHORT_S = 10
+LONG_S = 30
+MAX_OPS = 50_000  # safety bound; a healthy run lands well under this
+
+# Workloads whose generator is a state machine advanced by live
+# client/nemesis side effects — a pure simulation cannot drive them
+# (the quick executor never runs invoke(), so the state that gates the
+# next op never changes).  Each is exercised end-to-end by its own
+# suite test instead (e.g. tests/test_suite_aerospike.py runs pause
+# through the real interpreter).
+LIVE_FEEDBACK = {
+    ("aerospike", "pause"),
+}
+
+
+def _cases():
+    cases = []
+    for name in SUITES:
+        mod = load_suite(name)
+        workloads = getattr(mod, "WORKLOADS", None)
+        if workloads:
+            cases.extend((name, w) for w in sorted(workloads))
+        else:
+            cases.append((name, None))  # single-workload suite
+    return cases
+
+
+def _build(mod, suite_name, workload, time_limit):
+    opts = {
+        "ssh": {"dummy": True},
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "rate": RATE,
+        "time-limit": time_limit,
+        "faults": ["none"],
+        # highly divisible so independent.concurrent_generator accepts
+        # any of the suites' shard counts (2,3,4,5,6,10,12,15,20,30)
+        "concurrency": 60,
+        # chronos submits a job every job-interval seconds (30 by
+        # default, matching its reference); shrink it so the job stream
+        # recurs inside the sweep's short virtual windows
+        "job-interval": 2.0,
+    }
+    if workload is not None:
+        opts["workload"] = workload
+    fn = getattr(mod, f"{suite_name}_test", None) or mod.zk_test
+    return fn(opts)
+
+
+def _client_invokes(test):
+    """-> (total client invocations, {f: [positions]})."""
+    ctx = gen.context({"concurrency": test.get("concurrency", 60)})
+    history = simulate.quick_ops(ctx, test["generator"], test=test,
+                                 max_ops=MAX_OPS)
+    assert len(history) < MAX_OPS, (
+        "simulation hit the op cap — generator emits unboundedly at a "
+        "frozen virtual time (needs live feedback? add to "
+        "LIVE_FEEDBACK)")
+    positions: dict = {}
+    total = 0
+    for op in history:
+        if op.get("type") != "invoke" or op.get("process") == gen.NEMESIS:
+            continue
+        positions.setdefault(op.get("f"), []).append(total)
+        total += 1
+    return total, positions
+
+
+@pytest.mark.parametrize("suite_name,workload", _cases())
+def test_no_op_class_starves(suite_name, workload):
+    if (suite_name, workload) in LIVE_FEEDBACK:
+        pytest.skip("generator needs live client/nemesis feedback; "
+                    "covered by the suite's own interpreter-driven test")
+    mod = load_suite(suite_name)
+
+    short_total, _ = _client_invokes(
+        _build(mod, suite_name, workload, SHORT_S))
+    long_total, long_pos = _client_invokes(
+        _build(mod, suite_name, workload, LONG_S))
+
+    assert long_pos, f"{suite_name}/{workload}: no client ops at all"
+
+    # a class invoked exactly once is the lone-op starvation signature
+    # — unless its one op sits in the history's tail, where deliberate
+    # once-per-run final reads land
+    tail_start = long_total - max(1, long_total // 10)
+    starved = sorted(
+        str(f) for f, pos in long_pos.items()
+        if len(pos) == 1 and pos[0] < tail_start)
+    counts = {f: len(p) for f, p in long_pos.items()}
+    assert not starved, (
+        f"{suite_name}/{workload}: op classes {starved} invoked only "
+        f"once, mid-run — one-shot generator starvation "
+        f"(counts: {counts})")
+
+    # a recurring generator's op volume grows ~linearly with the time
+    # limit; a one-shot mix plateaus at the same count for both runs
+    assert long_total >= 1.8 * short_total, (
+        f"{suite_name}/{workload}: {short_total} ops at {SHORT_S}s but "
+        f"only {long_total} at {LONG_S}s — generator exhausts instead "
+        f"of recurring")

@@ -4,12 +4,9 @@ capabilities, composition, and the membership state machine loop.
 Mirrors `jepsen/test/jepsen/nemesis/combined_test.clj` behaviors.
 """
 
-import random
 
-import pytest
 
-from jepsen_tpu import control, db, generator as gen, net
-from jepsen_tpu import nemesis as nem
+from jepsen_tpu import db, generator as gen, net
 from jepsen_tpu.control import dummy
 from jepsen_tpu.nemesis import combined, membership
 from jepsen_tpu.util import majority

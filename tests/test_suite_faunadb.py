@@ -10,7 +10,7 @@ from fake_fauna import FakeFauna
 
 import jepsen_tpu.db as jdb
 import jepsen_tpu.os_ as jos
-from jepsen_tpu import core, independent
+from jepsen_tpu import core
 from jepsen_tpu.suites import faunadb as fdb
 from jepsen_tpu.suites import fauna_query as q
 from jepsen_tpu.suites.faunadb import (FaunaConn, FaunaError, Incomparable,

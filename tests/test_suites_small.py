@@ -2,7 +2,6 @@
 rethinkdb — DB command generation, client semantics against fakes, and
 hermetic end-to-end runs."""
 
-import json
 import re
 
 import jepsen_tpu.db
@@ -364,3 +363,85 @@ def test_logcabin_hermetic_run_catches_stale_reads(tmp_path):
                 if o.get("f") == "read" and o.get("type") == "ok")
     assert writes and reads
     assert done["results"]["workload"]["valid?"] is False
+
+
+def test_mysql_cluster_hermetic_run_catches_phantom_reads(tmp_path):
+    """An engine that answers one register read with a value nobody
+    ever wrote (writes draw from 0..4) must be flagged
+    nonlinearizable end to end."""
+    import sql_engine
+
+    class _CorruptingEngine(sql_engine.Engine):
+        def __init__(self):
+            super().__init__()
+            self.reads = 0
+
+        def session(self):
+            s = super().session()
+            eng = self
+            orig = s.execute
+
+            def execute(sql):
+                rows, cols = orig(sql)
+                if sql.lower().startswith(
+                        "select val from registers"):
+                    eng.reads += 1
+                    if eng.reads == 5:
+                        return [(7,)], cols
+                return rows, cols
+
+            s.execute = execute
+            return s
+
+    eng = _CorruptingEngine()
+    f = FakeMySQLServer(engine=eng)
+    try:
+        t = mysql_cluster.mysql_cluster_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+            "ssh": {"dummy": True}, "rate": 100, "time-limit": 3,
+            "faults": ["none"]})
+        t["db"] = jepsen_tpu.db.noop
+        t["os"] = jepsen_tpu.os_.noop
+        t["sql-conn-fn"] = lambda n: MyConn("127.0.0.1", f.port)
+        t["store-dir"] = str(tmp_path / "store")
+        done = core.run(t)
+        assert eng.reads >= 5, "history must reach the corrupted read"
+        assert done["results"]["workload"]["valid?"] is False
+    finally:
+        f.stop()
+
+
+def test_rethinkdb_hermetic_run_catches_phantom_reads(tmp_path):
+    """A fake that serves one document-cas read with a never-written
+    value must flip the per-key linearizability checker end to end."""
+    from jepsen_tpu.suites import reql_proto as rq
+
+    f = FakeRethinkDB()
+    corrupted = {"n": 0}
+
+    def corrupt(term, out):
+        # reads are `default(get_field(get(tbl, k), 'val'), None)`;
+        # corrupt the third concrete read (nil reads are
+        # unconstrained, so the lie must be a real value)
+        if (isinstance(term, list) and term[0] == rq.T_DEFAULT
+                and out is not None):
+            corrupted["n"] += 1
+            if corrupted["n"] == 3:
+                return 999
+        return out
+
+    f.corrupt_hook = corrupt
+    try:
+        t = rethinkdb.rethinkdb_test({
+            "nodes": ["n1", "n2", "n3"], "concurrency": 6,
+            "ssh": {"dummy": True}, "rate": 200, "time-limit": 3,
+            "faults": ["none"]})
+        t["db"] = jepsen_tpu.db.noop
+        t["os"] = jepsen_tpu.os_.noop
+        t["reql-conn-fn"] = lambda n: ReqlConn("127.0.0.1", f.port)
+        t["store-dir"] = str(tmp_path / "store")
+        done = core.run(t)
+        assert corrupted["n"] >= 3, "history must reach the lie"
+        assert done["results"]["workload"]["valid?"] is False
+    finally:
+        f.stop()
