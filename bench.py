@@ -8,7 +8,7 @@ Covers every BASELINE.md config plus the adversarial headline proof:
   * extra.adversarial_10k: a 10k-op history with front-loaded crashed
     writes (the shape the reference calls out at `checker.clj:213-216`
     — ":info ops hold slots forever", hours/32 GB on CPU knossos).
-    The host oracle is *measured* against a 60 s budget on this exact
+    The host oracle is *measured* against a budget on this exact
     history; when it blows the budget, its total runtime is projected
     linearly from the ops it processed (a lower bound: per-op cost is
     nondecreasing in this shape), capped at the 1 h north star. The
@@ -21,7 +21,13 @@ Covers every BASELINE.md config plus the adversarial headline proof:
       4 hazelcast-shape 50k ops sharded over the device mesh,
       5 tidb-shape 100k-txn elle list-append (north star < 300 s).
 
-Prints exactly one JSON line:
+Resilience: the TPU backend is reached through a relay that can wedge
+mid-session, so the orchestrator (default mode) runs every section in
+its OWN short-lived subprocess (`--section NAME`), with a preflight
+probe first and a shared persistent compilation cache.  A section that
+hangs costs its timeout and aborts the remaining device sections, but
+whatever completed is still reported — the driver always gets one
+parseable JSON line:
   {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N,
    "extra": {...}}
 """
@@ -124,6 +130,7 @@ def preflight_backend():
                       f"retrying immediately")
     return False, {"attempts": attempts}
 
+
 N_OPS = 10_000
 CONCURRENCY = 5
 BASELINE_OPS_PER_SEC = N_OPS / 3600.0  # CPU knossos: 1 h timeout on 10k ops
@@ -147,47 +154,43 @@ def _best_of(fn, n=3):
     return best, out
 
 
-def main() -> int:
-    ok, backend = preflight_backend()
-    if not ok:
-        # One diagnosable JSON line, never a stack trace: the driver
-        # records parsed output either way.
-        print(json.dumps({
-            "metric": ("linearizability verification throughput, 10k-op "
-                       "concurrent CAS-register history (WGL search)"),
-            "value": None,
-            "unit": "ops/s",
-            "vs_baseline": None,
-            "error": "tpu-backend-unavailable",
-            "extra": {"preflight": backend},
-        }))
-        return 1
-    _note(f"backend up: {backend['platform']} x{backend['n_devices']} "
-          f"({backend['device_kind']})")
+# ---- sections ----------------------------------------------------------
+#
+# Each section is one short-lived device process (never kill a process
+# mid-device-op: a kill can wedge the relay for the whole session; the
+# orchestrator only ever times out whole sections and then stops
+# scheduling device work).
 
+def _model():
     from jepsen_tpu import models
+    return models.cas_register()
+
+
+def section_headline():
+    """Easy 10k-op history (comparable to r01/r02)."""
     from jepsen_tpu.checker import synth
-    from jepsen_tpu.checker.elle import list_append, wr
-    from jepsen_tpu.checker.linear import analysis_host
-    from jepsen_tpu.checker.wgl import analysis_tpu, check_batch_sharded
+    from jepsen_tpu.checker.wgl import analysis_tpu
 
-    model = models.cas_register()
-    extra = {"backend": backend}
-
-    # ---- headline: easy 10k-op history (comparable to r01/r02) ----
-    _note("headline: easy 10k")
+    model = _model()
     hist = synth.register_history(N_OPS, concurrency=CONCURRENCY, values=5,
                                   crash_rate=0.0005, seed=45100)
     a = analysis_tpu(model, hist, budget_s=420)   # compile + first run
     assert a["valid?"] is True, f"benchmark history must verify: {a}"
     best, a = _best_of(lambda: analysis_tpu(model, hist))
     assert a["valid?"] is True
-    value = N_OPS / best
-    extra["wgl_best_s"] = round(best, 3)
-    extra["wgl_engine"] = a["analyzer"]
+    return {"value": round(N_OPS / best, 1),
+            "wgl_best_s": round(best, 3),
+            "wgl_engine": a["analyzer"]}
 
-    # ---- adversarial 10k: measured host blowout vs exact device ----
-    _note("adversarial 10k")
+
+def section_adversarial():
+    """Measured host blowout vs exact device on the front-loaded
+    crashed-writes shape."""
+    from jepsen_tpu.checker import UNKNOWN, synth
+    from jepsen_tpu.checker.linear import analysis_host
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
     adv = synth.adversarial_register_history(
         N_OPS, concurrency=6, crashed_writes=7, front_load=True,
         seed=45100)
@@ -195,7 +198,6 @@ def main() -> int:
     t0 = time.monotonic()
     ta = analysis_tpu(model, adv, budget_s=420)
     adv_tpu_s = time.monotonic() - t0
-    from jepsen_tpu.checker import UNKNOWN
 
     t0 = time.monotonic()
     host = analysis_host(model, adv, budget_s=HOST_BUDGET_S)
@@ -230,7 +232,7 @@ def main() -> int:
             "ops, a lower bound because per-op cost is nondecreasing "
             "here")
         speedup = round(min(projected, 3600.0) / adv_tpu_s, 1)
-    extra["adversarial_10k"] = {
+    return {"adversarial_10k": {
         "shape": "concurrency 6, 7 crashed writes front-loaded",
         "tpu": {"seconds": round(adv_tpu_s, 2),
                 "verdict": str(ta["valid?"]),
@@ -239,43 +241,65 @@ def main() -> int:
                 "configs_tracked": ta.get("max-frontier")},
         "host": host_info,
         "speedup_lower_bound": speedup,
-    }
+    }}
 
-    configs = {}
 
-    # ---- config 1: tutorial-scale 200-op register (parity) ----
-    _note("config 1")
+def section_config1():
+    """Tutorial-scale 200-op register (CPU parity target)."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.linear import analysis_host
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
     h1 = synth.register_history(200, concurrency=5, values=5,
                                 crash_rate=0.01, seed=45100)
+    analysis_tpu(model, h1, budget_s=420)   # compile
     t1_host, r1h = _best_of(lambda: analysis_host(model, h1))
     t1_tpu, r1t = _best_of(lambda: analysis_tpu(model, h1))
     assert r1h["valid?"] is True and r1t["valid?"] is True
-    configs["1_register_200"] = {
+    return {"1_register_200": {
         "host_s": round(t1_host, 4), "tpu_s": round(t1_tpu, 4),
-        "target": "parity", "tpu_over_host": round(t1_host / t1_tpu, 2)}
+        "target": "parity", "tpu_over_host": round(t1_host / t1_tpu, 2)}}
 
-    # ---- config 2: zookeeper-shape 2k-op WGL register ----
-    _note("config 2")
+
+def section_config2():
+    """zookeeper-shape 2k-op WGL register."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.linear import analysis_host
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
     h2 = synth.register_history(2000, concurrency=5, values=5,
                                 crash_rate=0.005, seed=45100)
+    analysis_tpu(model, h2, budget_s=420)   # compile
     t2_host, r2h = _best_of(lambda: analysis_host(model, h2), 1)
     t2_tpu, r2t = _best_of(lambda: analysis_tpu(model, h2))
     assert r2h["valid?"] is True and r2t["valid?"] is True
-    configs["2_register_wgl_2k"] = {
+    return {"2_register_wgl_2k": {
         "host_s": round(t2_host, 3), "tpu_s": round(t2_tpu, 3),
         "ops_per_s": round(2000 / t2_tpu, 1),
-        "speedup_vs_host": round(t2_host / t2_tpu, 2)}
+        "speedup_vs_host": round(t2_host / t2_tpu, 2)}}
 
-    # ---- config 3: cockroach-shape 10k-txn elle rw-register ----
-    _note("config 3")
+
+def section_config3():
+    """cockroach-shape 10k-txn elle rw-register."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.elle import wr
+
     h3 = synth.wr_history(10_000, seed=45100)
+    wr.check(h3)   # compile
     t3, r3 = _best_of(lambda: wr.check(h3))
     assert r3["valid?"] is True, f"wr bench history must verify: {r3}"
-    configs["3_elle_wr_10k"] = {
-        "seconds": round(t3, 2), "txns_per_s": round(10_000 / t3, 1)}
+    return {"3_elle_wr_10k": {
+        "seconds": round(t3, 2), "txns_per_s": round(10_000 / t3, 1)}}
 
-    # ---- config 4: 50k ops sharded over the device mesh ----
-    _note("config 4")
+
+def section_config4():
+    """hazelcast-shape 50k ops sharded over the device mesh."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.wgl import check_batch_sharded
+
+    model = _model()
     keys = 100
     per_key = [synth.register_history(500, concurrency=4, values=5,
                                       crash_rate=0.005, seed=1000 + i)
@@ -285,15 +309,20 @@ def main() -> int:
     all_ok, per_ok = check_batch_sharded(model, per_key, slots=16)
     t4 = time.monotonic() - t0
     assert all_ok and per_ok.all()
-    configs["4_sharded_50k"] = {
+    return {"4_sharded_50k": {
         "keys": keys, "seconds": round(t4, 2),
-        "ops_per_s": round(keys * 500 / t4, 1)}
+        "ops_per_s": round(keys * 500 / t4, 1)}}
 
-    # ---- config 5: 100k-txn elle list-append (best-of damps the
-    # ±10% run-to-run variance that read as a "regression" in r03 —
-    # the checker was byte-identical across those rounds) ----
-    _note("config 5")
+
+def section_config5():
+    """tidb-shape 100k-txn elle list-append (best-of damps the ±10%
+    run-to-run variance that read as a "regression" in r03 — the
+    checker was byte-identical across those rounds)."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.elle import list_append
+
     eh = synth.append_history(N_TXNS, seed=45100)
+    list_append.check(eh)   # compile
     elle_s, er = _best_of(lambda: list_append.check(eh))
     assert er["valid?"] is True, f"elle bench history must verify: {er}"
     elle_rate = N_TXNS / elle_s
@@ -302,20 +331,20 @@ def main() -> int:
     br = list_append.check(bad)
     elle_bad_s = time.monotonic() - t0
     assert br["valid?"] is False and "G1c" in br["anomaly-types"]
-    configs["5_elle_append_100k"] = {
+    return {"5_elle_append_100k": {
         "seconds": round(elle_s, 2), "txns_per_s": round(elle_rate, 1),
         "vs_baseline": round(elle_rate / BASELINE_TXNS_PER_SEC, 1),
-        "with_64_injected_cycles_s": round(elle_bad_s, 2)}
+        "with_64_injected_cycles_s": round(elle_bad_s, 2)}}
 
-    extra["configs"] = configs
 
-    # ---- generator throughput (reference: >20k ops/s single-thread,
-    # generator.clj:66-70) ----
-    _note("generator throughput")
+def section_generator():
+    """Generator throughput, host-only (reference: >20k ops/s
+    single-thread, generator.clj:66-70)."""
     import random as _random
 
     from jepsen_tpu import generator as gen
     from jepsen_tpu.generator import simulate
+
     rng = _random.Random(45100)
     n_gen = 50_000
     g = gen.clients(gen.limit(n_gen, gen.mix([
@@ -324,19 +353,140 @@ def main() -> int:
     ])))
     t0 = time.monotonic()
     simulate.quick(gen.context({"concurrency": 10}), g)
-    extra["generator_ops_per_s"] = round(
-        n_gen / (time.monotonic() - t0), 1)
+    return {"generator_ops_per_s": round(
+        n_gen / (time.monotonic() - t0), 1)}
 
-    print(json.dumps({
-        "metric": ("linearizability verification throughput, 10k-op "
-                   "concurrent CAS-register history (WGL search)"),
-        "value": round(value, 1),
-        "unit": "ops/s",
-        "vs_baseline": round(value / BASELINE_OPS_PER_SEC, 1),
-        "extra": extra,
-    }))
+
+# (name, fn, timeout_s, touches_device).  Budgets are generous: they
+# exist to bound a wedged relay, not to race healthy runs.
+SECTIONS = [
+    ("headline", section_headline, 900, True),
+    ("adversarial", section_adversarial, 600 + HOST_BUDGET_S, True),
+    ("config1", section_config1, 420, True),
+    ("config2", section_config2, 480, True),
+    ("config3", section_config3, 600, True),
+    ("config4", section_config4, 900, True),
+    ("config5", section_config5, 900, True),
+    ("generator", section_generator, 180, False),
+]
+
+
+def run_section(name: str) -> int:
+    fn = {n: f for n, f, _t, _d in SECTIONS}[name]
+    out = fn()
+    print(json.dumps(out), flush=True)
     return 0
 
 
+def main() -> int:
+    ok, backend = preflight_backend()
+    if not ok:
+        # One diagnosable JSON line, never a stack trace: the driver
+        # records parsed output either way.
+        print(json.dumps({
+            "metric": ("linearizability verification throughput, 10k-op "
+                       "concurrent CAS-register history (WGL search)"),
+            "value": None,
+            "unit": "ops/s",
+            "vs_baseline": None,
+            "error": "tpu-backend-unavailable",
+            "extra": {"preflight": backend},
+        }))
+        return 1
+    _note(f"backend up: {backend['platform']} x{backend['n_devices']} "
+          f"({backend['device_kind']})")
+
+    # one persistent compilation cache across the per-section processes,
+    # so each section only pays its own first-ever compile
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+
+    extra = {"backend": backend}
+    configs = {}
+    sections_meta = {}
+    headline = None
+    device_dead = False
+    for name, _fn, timeout_s, touches_device in SECTIONS:
+        if device_dead and touches_device:
+            sections_meta[name] = {"skipped": "backend wedged earlier"}
+            continue
+        _note(f"section {name} (budget {timeout_s:.0f}s)")
+        t0 = time.monotonic()
+        # Popen + wait, NOT subprocess.run(timeout=...): run() kills the
+        # child on timeout, and killing a process mid-device-op is the
+        # one thing that reliably wedges the relay for the whole
+        # session.  A timed-out child is ABANDONED (left running, pipes
+        # to temp files so nothing blocks) and no further device work is
+        # scheduled.
+        out_f = open(f"/tmp/bench_section_{name}.out", "w+")
+        err_f = open(f"/tmp/bench_section_{name}.err", "w+")
+        child = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "--section", name],
+            stdout=out_f, stderr=err_f, text=True, env=env)
+        try:
+            rc = child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            sections_meta[name] = {
+                "error": "timeout",
+                "seconds": round(time.monotonic() - t0, 1),
+                "abandoned_pid": child.pid}
+            if touches_device:
+                device_dead = True
+            continue
+        finally:
+            out_f.seek(0), err_f.seek(0)
+            stdout, stderr = out_f.read(), err_f.read()
+            out_f.close(), err_f.close()
+        dt = round(time.monotonic() - t0, 1)
+        if rc != 0 or not stdout.strip():
+            sections_meta[name] = {
+                "error": f"rc {rc}",
+                "seconds": dt,
+                "stderr_tail": stderr.strip().splitlines()[-1][:300]
+                if stderr.strip() else ""}
+            continue
+        try:
+            payload = json.loads(stdout.strip().splitlines()[-1])
+        except ValueError:
+            sections_meta[name] = {
+                "error": "unparseable section output",
+                "stdout_tail": stdout.strip()[-300:]}
+            continue
+        sections_meta[name] = {"seconds": dt}
+        if name == "headline":
+            headline = payload
+            extra["wgl_best_s"] = payload["wgl_best_s"]
+            extra["wgl_engine"] = payload["wgl_engine"]
+        elif name == "adversarial":
+            extra.update(payload)
+        elif name.startswith("config"):
+            configs.update(payload)
+        elif name == "generator":
+            extra.update(payload)
+
+    extra["configs"] = configs
+    extra["sections"] = sections_meta
+    value = headline["value"] if headline else None
+    out = {
+        "metric": ("linearizability verification throughput, 10k-op "
+                   "concurrent CAS-register history (WGL search)"),
+        "value": value,
+        "unit": "ops/s",
+        "vs_baseline": round(value / BASELINE_OPS_PER_SEC, 1)
+        if value else None,
+        "extra": extra,
+    }
+    if any("error" in m for m in sections_meta.values()):
+        out["error"] = "partial: " + ", ".join(
+            n for n, m in sections_meta.items() if "error" in m)
+    print(json.dumps(out))
+    return 0 if "error" not in out else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--section":
+        sys.exit(run_section(sys.argv[2]))
     sys.exit(main())

@@ -136,7 +136,7 @@ def concurrency_limit(limit: int, checker) -> Checker:
 
 # Re-exports of the standard checkers (defined in submodules).
 from .basic import (  # noqa: E402
-    counter, log_file_pattern, queue, set_checker, set_full, stats,
+    counter, counter_plot, log_file_pattern, queue, set_checker, set_full, stats,
     total_queue, unhandled_exceptions, unique_ids,
 )
 from .clock import clock_plot  # noqa: E402
@@ -150,7 +150,8 @@ __all__ = [
     "Checker", "UNKNOWN", "merge_valid", "check_safe", "compose",
     "concurrency_limit", "noop", "unbridled_optimism", "coerce",
     "stats", "unhandled_exceptions", "set_checker", "set_full", "queue",
-    "total_queue", "unique_ids", "counter", "log_file_pattern",
+    "total_queue", "unique_ids", "counter", "counter_plot",
+    "log_file_pattern",
     "linearizable", "latency_graph", "rate_graph", "perf_checker",
     "clock_plot",
 ]

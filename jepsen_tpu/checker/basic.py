@@ -434,6 +434,82 @@ def counter() -> Checker:
     return CounterChecker()
 
 
+class CounterPlotChecker(Checker):
+    """Renders counter.svg: the admissible [lower, upper] band over
+    time (lower = sum of acked adds, upper = sum of attempted adds)
+    with each observed read on top — green inside the band, red
+    outside.  The reference wants exactly this plot (its `doc/plan.md`
+    "add a plot for counters, showing the upper and lower bounds, and
+    the observed value"); compose it next to `counter()`, which does
+    the judging."""
+
+    def check(self, test, hist, opts):
+        from .. import plot as gp
+        from .perf import out_path
+
+        hist = History(hist).client_ops()
+        # same pair semantics as CounterChecker: a failed completion
+        # definitely did not happen, so its invoke must not widen the
+        # upper bound — otherwise the plot green-lights reads the
+        # counter checker rejects
+        pairs = hist.pair_index()
+        drop = set()
+        for i, o in enumerate(hist.ops):
+            if is_fail(o):
+                drop.add(i)
+                j = pairs.get(i)
+                if j is not None:
+                    drop.add(j)
+        lower = upper = 0
+        t0 = hist.ops[0]["time"] if hist.ops else 0
+        lows, highs, ok_reads, bad_reads = [], [], [], []
+        pending: dict[int, int] = {}  # process -> lower at invoke
+        for i, o in enumerate(hist.ops):
+            if i in drop:
+                continue
+            t = (o["time"] - t0) / 1e9
+            ty, f, p = o["type"], o["f"], o["process"]
+            if f == "add":
+                if ty == "invoke":
+                    upper += o["value"]
+                    highs.append((t, upper))
+                elif ty == "ok":
+                    lower += o["value"]
+                    lows.append((t, lower))
+            elif f == "read":
+                if ty == "invoke":
+                    pending[p] = lower
+                elif ty == "ok":
+                    lo = pending.pop(p, lower)
+                    tgt = ok_reads if lo <= o["value"] <= upper \
+                        else bad_reads
+                    tgt.append((t, o["value"]))
+        p = gp.Plot(title=f"{test.get('name', '')} counter",
+                    ylabel="Value")
+        if lows:
+            p.series.append(gp.Series(
+                title="lower bound (acked adds)", data=lows,
+                color="#4477aa", mode="steps"))
+        if highs:
+            p.series.append(gp.Series(
+                title="upper bound (attempted adds)", data=highs,
+                color="#FFA400", mode="steps"))
+        if ok_reads:
+            p.series.append(gp.Series(
+                title="read", data=ok_reads, color="#6DB6FE",
+                mode="points", point_type=1))
+        if bad_reads:
+            p.series.append(gp.Series(
+                title="read out of bounds", data=bad_reads,
+                color="#FF1E90", mode="points", point_type=2))
+        gp.write(p, out_path(test, opts, "counter.svg"))
+        return {"valid?": True}
+
+
+def counter_plot() -> Checker:
+    return CounterPlotChecker()
+
+
 class LogFilePattern(Checker):
     """Greps each node's downloaded log file for a pattern; matches make the
     history invalid (reference checker.clj:839-881)."""

@@ -243,6 +243,13 @@ class Linearizable(Checker):
     neighborhood to linear.svg in the test's store directory (the
     reference renders knossos analyses the same way,
     checker.clj:205-212).
+
+    Extra keyword options flow straight to the device engine
+    (`wgl.analysis_tpu`), so the search heuristics are user-tunable the
+    way knossos's memoization threshold should have been (its plan.md
+    asks for this): `engine='auto'|'dense'|'sort'`, `frontier`,
+    `max_frontier`, `chunk_entries`, `budget_s`, e.g.
+    ``linearizable({'model': m, 'engine': 'dense', 'budget_s': 120})``.
     """
 
     def __init__(self, model: m.Model, algorithm: str = "auto", **opts):

@@ -155,7 +155,15 @@ def parse_nodes(opts: dict) -> dict:
     if nodes_file:
         with open(nodes_file) as f:
             from_file = [ln.strip() for ln in f if ln.strip()]
-    opts["nodes"] = list(from_file) + list(nodes or []) + list(node or [])
+    merged = list(from_file) + list(nodes or []) + list(node or [])
+    dupes = sorted({n for n in merged if merged.count(n) > 1})
+    if dupes:
+        # complain early: a duplicated node would open two control
+        # sessions to the same host and only fail much later as a
+        # port-bind error on the node
+        raise ValueError(f"node(s) listed more than once: "
+                         f"{', '.join(dupes)}")
+    opts["nodes"] = merged
     return opts
 
 

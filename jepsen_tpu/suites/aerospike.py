@@ -487,6 +487,7 @@ def counter_workload(opts) -> dict:
         # search is genuinely exponential past the device slot cap
         "checker": checker.compose({
             "counter": checker.counter(),
+            "counter-plot": checker.counter_plot(),
             "linear": linear.linearizable(
                 models.counter(),
                 budget_s=opts.get("linear-budget-s", 60)),

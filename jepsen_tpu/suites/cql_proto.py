@@ -164,11 +164,18 @@ class Conn:
         payload 0x04, warning 0x08) are stripped so result offsets
         stay correct."""
         # time-bounded drain: a long stale backlog must not turn a
-        # recoverable read into connection churn, but the wait can't
-        # exceed one socket-timeout window either
-        deadline = _time.monotonic() + (self.sock.gettimeout() or 5.0)
-        while _time.monotonic() < deadline:
+        # recoverable read into connection churn. The bound is on the
+        # INTER-frame gap (reset after every frame), not the total
+        # drain — a response that is still flowing in behind stale
+        # frames must be delivered, however long the backlog. A large
+        # absolute frame cap backstops a server looping stale frames.
+        window = self.sock.gettimeout() or 5.0
+        deadline = _time.monotonic() + window
+        for _ in range(4096):
+            if _time.monotonic() >= deadline:
+                break
             hdr = self._recv_exact(9)
+            deadline = _time.monotonic() + window
             _ver, flags, stream, opcode, length = struct.unpack(
                 "!BBhBI", hdr)
             body = self._recv_exact(length)

@@ -1139,7 +1139,8 @@ def counter_workload(opts, client) -> dict:
             "generator": gen.mix([r] + [add] * 100),
             "checker": checker.compose({
                 "timeline": timeline.html(),
-                "counter": checker.counter()})}
+                "counter": checker.counter(),
+                "counter-plot": checker.counter_plot()})}
 
 
 def set_workload(opts, client) -> dict:

@@ -81,16 +81,72 @@ def test_row(t: dict) -> str:
         "</tr>")
 
 
-def home_page(base: str) -> str:
-    rows = sorted(fast_tests(base), key=lambda t: t["start-time"],
-                  reverse=True)
+SORT_KEYS = {
+    "name": lambda t: t["name"],
+    "time": lambda t: t["start-time"],
+    "valid": lambda t: str((t.get("results") or {}).get("valid?")),
+}
+
+
+def select_tests(tests: list[dict], params: dict) -> list[dict]:
+    """Search/filter/sort the home-page rows (the reference's plan.md
+    wants exactly these: search, sorting, filtering).
+
+    params: q (substring match on name), valid (true/false/unknown/
+    incomplete), sort (name|time|valid), dir (asc|desc)."""
+    q = (params.get("q") or "").strip().lower()
+    if q:
+        tests = [t for t in tests if q in t["name"].lower()]
+    want = (params.get("valid") or "").strip().lower()
+    if want:
+        tests = [
+            t for t in tests
+            if str((t.get("results") or {}).get("valid?")).lower() == want]
+    key = SORT_KEYS.get(params.get("sort") or "time", SORT_KEYS["time"])
+    default_desc = (params.get("sort") or "time") == "time"
+    desc = {"asc": False, "desc": True}.get(
+        (params.get("dir") or "").lower(), default_desc)
+    return sorted(tests, key=key, reverse=desc)
+
+
+def _sort_link(col: str, params: dict) -> str:
+    cur = params.get("sort") or "time"
+    cur_desc = (params.get("dir") or
+                ("desc" if cur == "time" else "asc")) == "desc"
+    nxt = "asc" if (col != cur or cur_desc) else "desc"
+    qs = urllib.parse.urlencode(
+        {k: v for k, v in {**params, "sort": col, "dir": nxt}.items()
+         if v})
+    arrow = (" ▼" if cur_desc else " ▲") if col == cur else ""
+    return f'<a href="/?{qs}">{col.capitalize()}{arrow}</a>'
+
+
+def home_page(base: str, params: dict | None = None) -> str:
+    params = params or {}
+    rows = select_tests(fast_tests(base), params)
+    q = html.escape(params.get("q") or "", quote=True)
+    valid = params.get("valid") or ""
+    options = "".join(
+        f'<option value="{v}"{" selected" if v == valid else ""}>'
+        f"{label}</option>"
+        for v, label in [("", "any validity"), ("true", "valid"),
+                         ("false", "invalid"), ("unknown", "unknown"),
+                         ("incomplete", "incomplete")])
     return (
         "<html><body><h1>Jepsen</h1>"
+        '<form method="get" action="/">'
+        f'<input type="text" name="q" value="{q}" '
+        'placeholder="search test names">'
+        f'<select name="valid">{options}</select>'
+        '<input type="submit" value="filter">'
+        "</form>"
         '<table cellspacing="3" cellpadding="3"><thead><tr>'
-        "<th>Name</th><th>Time</th><th>Valid?</th><th>Results</th>"
+        f"<th>{_sort_link('name', params)}</th>"
+        f"<th>{_sort_link('time', params)}</th>"
+        f"<th>{_sort_link('valid', params)}</th><th>Results</th>"
         "<th>History</th><th>Log</th><th>Zip</th></tr></thead><tbody>"
         + "".join(test_row(t) for t in rows)
-        + "</tbody></table></body></html>")
+        + f"</tbody></table><p>{len(rows)} run(s)</p></body></html>")
 
 
 def dir_listing(base: str, rel: str, full: str) -> str:
@@ -153,6 +209,10 @@ class Handler(BaseHTTPRequestHandler):
 
     def _send(self, code: int, body: bytes, ctype: str = "text/html"):
         self.send_response(code)
+        if ctype.startswith("text/") and "charset" not in ctype:
+            # explicit utf-8: the reference serves latin-1-ish bytes
+            # and its plan.md wants this fixed
+            ctype += "; charset=utf-8"
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -168,9 +228,13 @@ class Handler(BaseHTTPRequestHandler):
         return full
 
     def do_GET(self):  # noqa: N802 — http.server API
-        path = urllib.parse.unquote(urllib.parse.urlsplit(self.path).path)
+        split = urllib.parse.urlsplit(self.path)
+        path = urllib.parse.unquote(split.path)
         if path in ("/", ""):
-            return self._send(200, home_page(self.base).encode())
+            params = {k: v[0]
+                      for k, v in urllib.parse.parse_qs(split.query).items()}
+            return self._send(
+                200, home_page(self.base, params).encode())
         if path.startswith("/files"):
             rel = path[len("/files"):].strip("/")
             if rel.endswith(".zip"):

@@ -343,3 +343,42 @@ def test_log_file_pattern(tmp_path):
                                                           {})
     assert r["valid?"] is False and r["count"] == 1
     assert r["matches"][0]["node"] == "n1"
+
+
+def test_counter_plot_renders_bounds_and_reads(tmp_path):
+    hist = History([
+        op("invoke", "add", 2, process=0, time=0),
+        op("ok", "add", 2, process=0, time=1_000_000_000),
+        op("invoke", "read", None, process=1, time=2_000_000_000),
+        op("ok", "read", 2, process=1, time=3_000_000_000),
+        op("invoke", "read", None, process=1, time=4_000_000_000),
+        op("ok", "read", 99, process=1, time=5_000_000_000),  # phantom
+    ])
+    test = {"name": "counter-plot", "start-time": "t1",
+            "store-dir": str(tmp_path)}
+    r = c.counter_plot().check(test, hist, {})
+    assert r["valid?"] is True  # plots render, they don't judge
+    svg_path = tmp_path / "counter-plot" / "t1" / "counter.svg"
+    svg = svg_path.read_text()
+    assert "lower bound" in svg and "upper bound" in svg
+    assert "read out of bounds" in svg
+
+
+def test_counter_plot_ignores_failed_adds(tmp_path):
+    """A failed add definitely did not happen: the plot's upper bound
+    must match counter()'s semantics, which drop the pair."""
+    hist = History([
+        op("invoke", "add", 2, process=0, time=0),
+        op("fail", "add", 2, process=0, time=1_000_000_000),
+        op("invoke", "read", None, process=1, time=2_000_000_000),
+        op("ok", "read", 2, process=1, time=3_000_000_000),
+    ])
+    test = {"name": "counter-plot-fail", "start-time": "t1",
+            "store-dir": str(tmp_path)}
+    assert c.counter().check({}, hist, {})["valid?"] is False
+    c.counter_plot().check(test, hist, {})
+    svg = (tmp_path / "counter-plot-fail" / "t1" /
+           "counter.svg").read_text()
+    # the read of 2 must render as out of bounds (upper stayed 0)
+    assert "read out of bounds" in svg
+    assert "upper bound" not in svg  # no surviving add invokes

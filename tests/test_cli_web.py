@@ -226,7 +226,12 @@ def test_web_server_end_to_end(populated_store):
         assert json.loads(body)["valid?"] is True
 
         status, ctype, body = get(f"/files/cli-test/{run}/jepsen.log")
-        assert ctype == "text/plain"
+        assert ctype == "text/plain; charset=utf-8"
+
+        status, _, body = get("/?q=cli&valid=true&sort=name&dir=asc")
+        assert status == 200 and b"cli-test" in body
+        status, _, body = get("/?q=no-such-test")
+        assert status == 200 and b"cli-test" not in body
 
         status, ctype, body = get(f"/files/cli-test/{run}.zip")
         assert status == 200 and ctype == "application/zip"
@@ -258,3 +263,38 @@ def test_repl_latest(populated_store):
     re = repl.recheck(dict(t, **{"store-dir": populated_store}),
                       checker.stats())
     assert re["results"]["valid?"] is True
+
+
+def test_duplicate_nodes_rejected_early():
+    with pytest.raises(ValueError, match="more than once"):
+        cli.parse_nodes({"node": ["n1", "n2", "n1"]})
+    with pytest.raises(SystemExit) as e:
+        cli.run({"test": {"opt_spec": cli.test_opt_spec(),
+                          "opt_fn": cli.test_opt_fn,
+                          "run": lambda o: None}},
+                ["test", "--node", "a", "--node", "a"])
+    assert e.value.code == 254
+
+
+def test_select_tests_search_filter_sort():
+    mk = lambda name, t, v: {"name": name, "start-time": t,  # noqa: E731
+                             "results": {"valid?": v}}
+    ts = [mk("etcd", "2026-01-02", True),
+          mk("etcd", "2026-01-01", False),
+          mk("zookeeper", "2026-01-03", "unknown")]
+    # default: newest first
+    assert [t["start-time"] for t in web.select_tests(ts, {})] == \
+        ["2026-01-03", "2026-01-02", "2026-01-01"]
+    # search narrows by name substring
+    assert all(t["name"] == "etcd"
+               for t in web.select_tests(ts, {"q": "etc"}))
+    # validity filter matches stringified valid?
+    assert [t["start-time"]
+            for t in web.select_tests(ts, {"valid": "false"})] == \
+        ["2026-01-01"]
+    assert [t["start-time"]
+            for t in web.select_tests(ts, {"valid": "unknown"})] == \
+        ["2026-01-03"]
+    # explicit sort by name ascending
+    got = web.select_tests(ts, {"sort": "name", "dir": "asc"})
+    assert [t["name"] for t in got] == ["etcd", "etcd", "zookeeper"]

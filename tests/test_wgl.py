@@ -618,3 +618,19 @@ def test_blame_matches_host_oracle_on_corrupted_histories():
                              engine=engine, explain=False)
             assert a["valid?"] is False is host["valid?"]
             assert a["op-index"] == host["op-index"], (engine, seed)
+
+
+def test_linearizable_checker_passes_engine_options_through():
+    """The checker factory exposes the device-engine tunables (the
+    knossos plan.md wish: search heuristics as user options)."""
+    from jepsen_tpu.checker.linear import linearizable
+    from jepsen_tpu.checker.synth import register_history
+
+    h = register_history(120, concurrency=4, values=3, crash_rate=0.0,
+                         seed=45100)
+    for engine, marker in (("dense", "tpu-wgl-dense"), ("sort", "tpu-wgl")):
+        chk = linearizable({"model": models.cas_register(),
+                            "engine": engine})
+        r = chk.check({"name": "t"}, h, {})
+        assert r["valid?"] is True
+        assert r["analyzer"] == marker, r
