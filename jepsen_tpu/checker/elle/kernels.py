@@ -35,6 +35,30 @@ import numpy as np
 
 _WW, _WR, _RW = 1, 2, 4
 
+# mask <-> {'ww','wr','rw'} tables.  MASK_SETS gives the graph builders
+# shared frozensets (no per-edge allocation); SET_MASK lets
+# analyze_edges recover the mask by hash instead of three membership
+# tests.  Frozensets hash by content, so any equal frozenset hits.
+MASK_SETS = {
+    m: frozenset(n for bit, n in ((_WW, "ww"), (_WR, "wr"), (_RW, "rw"))
+                 if m & bit)
+    for m in range(8)
+}
+SET_MASK = {s: m for m, s in MASK_SETS.items()}
+
+
+def type_mask(types) -> int:
+    """Edge types (frozenset/set of names, or an int mask) -> int mask."""
+    if isinstance(types, int):
+        return types
+    if isinstance(types, frozenset):
+        m = SET_MASK.get(types)
+        if m is not None:
+            return m
+    return ((_WW if "ww" in types else 0)
+            | (_WR if "wr" in types else 0)
+            | (_RW if "rw" in types else 0))
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     """Round up to a power of two (min 8) so recompilation is rare and
@@ -319,14 +343,7 @@ def analyze_edges(n: int, edges: dict, mesh=None,
     for ix, ((i, j), types) in enumerate(plain.items()):
         src[ix] = i
         dst[ix] = j
-        t = 0
-        if "ww" in types:
-            t |= _WW
-        if "wr" in types:
-            t |= _WR
-        if "rw" in types:
-            t |= _RW
-        tmask[ix] = t
+        tmask[ix] = type_mask(types)
 
     labels = scc_labels(n, src, dst)
     sizes = np.bincount(labels)

@@ -40,6 +40,13 @@ def _is_append(m) -> bool:
     return m[0] == "append"
 
 
+# edge-type bitmask for graph()'s hot accumulation path; kernels owns
+# the canonical bits and the mask -> shared-frozenset table in the
+# {(i, j): {'ww', ...}} shape the cycle analyzers consume
+_WW, _WR, _RW = kernels._WW, kernels._WR, kernels._RW
+_MASK_SETS = kernels.MASK_SETS
+
+
 def op_internal_case(op: dict) -> dict | None:
     """A txn's reads must be consistent with its own earlier appends: a
     read of k after this txn appended vs must end with those vs in
@@ -131,11 +138,16 @@ class _Analysis:
 
     def g1a_cases(self) -> list:
         """Reads observing a failed append (`aborted read`)."""
-        cases = []
         fw = self.failed_writes
+        if not fw:
+            return []   # no failed appends: nothing to observe
+        # only reads of keys with a failed append can hit; scanning
+        # every element of every read otherwise costs ~1s per 100k txns
+        fkeys = {k for k, _v in fw}
+        cases = []
         for o in self.oks:
             for m in o.get("value") or ():
-                if m[0] == "r" and m[2]:
+                if m[0] == "r" and m[2] and m[1] in fkeys:
                     k = m[1]
                     for v in m[2]:
                         w = fw.get((k, v))
@@ -176,13 +188,17 @@ def graph(hist):
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    edges: dict[tuple, set] = {}
-    _setdefault = edges.setdefault
+    # hot path (~5 calls per op on 100k-txn histories): accumulate edge
+    # types as an int bitmask — no per-edge set allocation — and convert
+    # to the {(i, j): {type, ...}} shape consumers read once, at the
+    # end, through a 7-entry shared-frozenset table
+    acc: dict[tuple, int] = {}
+    _get = acc.get
 
-    def add(i, j, typ):
-        # hot path: ~5 calls per op on 100k-txn histories
+    def add(i, j, bit):
         if i != j:
-            _setdefault((i, j), set()).add(typ)
+            key = (i, j)
+            acc[key] = _get(key, 0) | bit
 
     orders, incompatible = a.version_orders()
     # ww along each key's observed version chain
@@ -191,7 +207,7 @@ def graph(hist):
         for v1, v2 in zip(chain, chain[1:]):
             w1, w2 = writers.get(v1), writers.get(v2)
             if w1 and w2:
-                add(idx[id(w1[0])], idx[id(w2[0])], "ww")
+                add(idx[id(w1[0])], idx[id(w2[0])], _WW)
     # never-observed :ok appends per key (not in the longest chain)
     unobserved: dict[Any, list] = {}
     for k, writers in a.writer_of.items():
@@ -213,7 +229,7 @@ def graph(hist):
             if vs:
                 w = writers.get(vs[-1])
                 if w is not None and id(w[0]) != id(o):
-                    add(idx[id(w[0])], i_reader, "wr")
+                    add(idx[id(w[0])], i_reader, _WR)
             # first in-chain successor with a known writer (observed =>
             # committed, so info writers count too). Versions with no
             # known writer — phantom values a corrupt store fabricated —
@@ -226,12 +242,13 @@ def graph(hist):
                 w2 = writers.get(chain[p])
                 if w2 is not None:
                     if id(w2[0]) != id(o):
-                        add(i_reader, idx[id(w2[0])], "rw")
+                        add(i_reader, idx[id(w2[0])], _RW)
                     break
                 p += 1
             for wop in unobserved.get(k, ()):
                 if id(wop) != id(o):
-                    add(i_reader, idx[id(wop)], "rw")
+                    add(i_reader, idx[id(wop)], _RW)
+    edges = {k: _MASK_SETS[m] for k, m in acc.items()}
     return txns, edges, a, incompatible
 
 

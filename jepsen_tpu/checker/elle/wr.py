@@ -30,6 +30,9 @@ from ... import txn as mop
 from ...history import history as as_history, is_fail, is_info, is_ok
 from . import kernels
 
+_WW, _WR, _RW = kernels._WW, kernels._WR, kernels._RW
+_MASK_SETS = kernels.MASK_SETS
+
 _INIT = object()  # the unwritten initial state (reads return None)
 
 
@@ -131,11 +134,15 @@ def graph(hist):
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    edges: dict[tuple, set] = {}
+    # same bitmask accumulation as list_append.graph: no per-edge set
+    # allocation on the hot path, one conversion at the end
+    acc: dict[tuple, int] = {}
+    _get = acc.get
 
-    def add(i, j, typ):
+    def add(i, j, bit):
         if i != j:
-            edges.setdefault((i, j), set()).add(typ)
+            key = (i, j)
+            acc[key] = _get(key, 0) | bit
 
     # wr: writer -> external readers (exact)
     for o in a.oks:
@@ -144,7 +151,7 @@ def graph(hist):
                 continue
             w = a.writer_of.get((k, v))
             if w is not None:
-                add(idx[id(w[0])], idx[id(o)], "wr")
+                add(idx[id(w[0])], idx[id(o)], _WR)
 
     pairs = a.version_pairs()
     writers_by_key: dict[Any, list] = {}
@@ -160,7 +167,7 @@ def graph(hist):
             if u is not _INIT:
                 wu = a.writer_of.get((k, u))
                 if wu is not None:
-                    add(idx[id(wu[0])], idx[id(wv[0])], "ww")
+                    add(idx[id(wu[0])], idx[id(wv[0])], _WW)
 
     # rw: external reader of u -> writers of known successors of u;
     # a read of nil anti-depends on every writer of that key
@@ -172,12 +179,13 @@ def graph(hist):
         for k, v in mop.ext_reads(o.get("value") or ()).items():
             if v is None:
                 for _, w in writers_by_key.get(k, ()):
-                    add(idx[id(o)], idx[id(w)], "rw")
+                    add(idx[id(o)], idx[id(w)], _RW)
             else:
                 for v2 in succ.get((k, v), ()):
                     w2 = a.writer_of.get((k, v2))
                     if w2 is not None:
-                        add(idx[id(o)], idx[id(w2[0])], "rw")
+                        add(idx[id(o)], idx[id(w2[0])], _RW)
+    edges = {k: _MASK_SETS[m] for k, m in acc.items()}
     return txns, edges, a
 
 

@@ -252,11 +252,31 @@ def point_graph(test, hist, opts=None) -> Optional[str]:
     return gp.write(p, out_path(test, opts, "latency-raw.svg"))
 
 
-def quantiles_graph(test, hist, opts=None, dt: float = 30,
+NICE_DTS = (1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, 3600)
+
+
+def adaptive_dt(hist, target_buckets: int = 60) -> float:
+    """Bucket width giving ~target_buckets windows over the history's
+    duration, snapped to a human-friendly step.  Fixed 30 s windows (the
+    reference's default) flatten a one-minute test into two points and
+    oversample a day-long soak; adapting to point density is its
+    plan.md "adaptive temporal resolution" item."""
+    t_max = util.nanos_to_secs(max((o.get("time", 0) for o in hist),
+                                   default=0))
+    want = t_max / max(target_buckets, 1)
+    for dt in NICE_DTS:
+        if dt >= want:
+            return dt
+    return NICE_DTS[-1]
+
+
+def quantiles_graph(test, hist, opts=None, dt: float | None = None,
                     qs=(0.5, 0.95, 0.99, 1)) -> Optional[str]:
     """Latency quantiles per f over dt-second windows
-    (`perf.clj:513-550`)."""
+    (`perf.clj:513-550`); dt=None picks an adaptive width."""
     hist = util.history_latencies(hist)
+    if dt is None:
+        dt = adaptive_dt(hist)
     colors = qs_to_colors(qs)
     datasets = {
         f: latencies_to_quantiles(dt, qs, [latency_point(o) for o in ops
@@ -277,10 +297,14 @@ def quantiles_graph(test, hist, opts=None, dt: float = 30,
     return gp.write(p, out_path(test, opts, "latency-quantiles.svg"))
 
 
-def rate_graph(test, hist, opts=None, dt: float = 10) -> Optional[str]:
+def rate_graph(test, hist, opts=None, dt: float | None = None
+               ) -> Optional[str]:
     """Completion rate (hz) by f and type over dt-second buckets;
-    nemesis completions are excluded (`perf.clj:559-599`)."""
+    nemesis completions are excluded (`perf.clj:559-599`).  dt=None
+    picks an adaptive width."""
     hist = history(hist)
+    if dt is None:
+        dt = adaptive_dt(hist)
     t_max = util.nanos_to_secs(max((o.get("time", 0) for o in hist),
                                    default=0))
     datasets: dict = {}

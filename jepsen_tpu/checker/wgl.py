@@ -1014,22 +1014,36 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                 k.check(x, jnp.int32(steps.n), init_state))
         else:
             carry = k.init_carry(init_state)
+            # Pipelined chunk loop: enqueue chunk i (dispatch is async),
+            # THEN read chunk i-1's liveness flag — the device computes
+            # chunk i while the host waits on the already-finished
+            # flag, so the per-chunk host<->device sync overlaps with
+            # compute instead of serializing after it.  Safe to
+            # speculate one chunk past a death: an empty frontier stays
+            # empty, and on death we discard the speculated carry.
             e = 0
             while e < steps.n:
                 stop = min(e + chunk_entries, steps.n)
-                carry = k.check_chunk(x, jnp.int32(stop), carry)
+                nxt = k.check_chunk(x, jnp.int32(stop), carry)
+                prev, carry = carry, nxt
                 e = stop
-                if int(carry[-2]) == 0:   # frontier died: definite
+                if int(prev[-2]) == 0:
+                    carry = prev   # frontier died last chunk: definite
                     break
                 # only give up when chunks remain — a search that just
                 # finished is definitive regardless of elapsed time
                 if e < steps.n:
-                    if budget_s is not None and \
-                            _time.monotonic() - t0 > budget_s:
+                    over = budget_s is not None and \
+                        _time.monotonic() - t0 > budget_s
+                    stop_req = cancel is not None and cancel()
+                    if over or stop_req:
+                        # the in-flight chunk may already have decided:
+                        # block on its flag before downgrading a
+                        # definite death to 'unknown'
+                        if int(carry[-2]) == 0:
+                            break
                         timed_out = True
-                        break
-                    if cancel is not None and cancel():
-                        timed_out = cancelled = True
+                        cancelled = stop_req and not over
                         break
             ok, death, overflow, max_count = jax.device_get(
                 k.summarize(carry))
@@ -1186,14 +1200,20 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         carry = jax.vmap(k.init_carry)(s0)
         e = 0
         n_max = int(ns.max())
+        # pipelined like the scalar loop: enqueue the next vmapped
+        # chunk, then read the PREVIOUS chunk's frontier counts while
+        # the device computes — all-dead detection lags one chunk
+        # (safe: dead frontiers stay dead) in exchange for overlapping
+        # the per-chunk sync with compute
         while e < n_max:
             stop = min(e + chunk_entries, n_max)
-            carry = k.check_chunk_batch(
+            nxt = k.check_chunk_batch(
                 x, jnp.asarray(np.minimum(ns, stop)), carry)
+            prev, carry = carry, nxt
             e = stop
-            counts = np.asarray(carry[-2])
-            if not counts.any():
-                break   # every frontier died: all verdicts definite
+            if not np.asarray(prev[-2]).any():
+                carry = prev   # every frontier died: all definite
+                break
             if e < n_max:
                 if (budget_s is not None
                         and _time.monotonic() - t0 > budget_s) \

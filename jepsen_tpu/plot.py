@@ -23,6 +23,10 @@ from xml.sax.saxutils import escape
 # canvas geometry (reference: `set term png size 900,400`)
 WIDTH = 900
 HEIGHT = 400
+# past this many points a series renders translucent, so overplotted
+# regions read as density
+DENSE_POINTS = 1500
+DENSE_ALPHA = 0.35
 MARGIN_L = 72
 MARGIN_R = 168   # legend lives here ("set key outside top right")
 MARGIN_T = 34
@@ -356,8 +360,21 @@ def render(plot: Plot) -> str:
                        f'stroke-width="{s.line_width}" fill="none"/>')
         if s.mode in ("points", "linespoints"):
             r = 2.4 if s.mode == "points" else 2.8
-            for px, py in pts:
-                out.append(_marker(shape, px, py, r, s.color))
+            if len(pts) > DENSE_POINTS:
+                # dense clouds: PER-MARKER translucency, so overlapping
+                # points darken each other and overplotted regions read
+                # as density (the reference wants this, its plan.md
+                # "make points somewhat transparent"). Group-level
+                # opacity would composite the layer as one unit and
+                # flatten the overlaps.
+                out.append(f'<g fill-opacity="{DENSE_ALPHA}" '
+                           f'stroke-opacity="{DENSE_ALPHA}">')
+                out.extend(_marker(shape, px, py, r, s.color)
+                           for px, py in pts)
+                out.append('</g>')
+            else:
+                out.extend(_marker(shape, px, py, r, s.color)
+                           for px, py in pts)
     out.append('</g>')
 
     # legend, outside top right

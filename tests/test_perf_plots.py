@@ -267,3 +267,31 @@ def test_clock_plot_checker(test_map):
 
 def test_clock_plot_empty(test_map):
     assert clock_plot().check(test_map, history([]), {})["valid?"] is True
+
+
+def test_adaptive_dt_scales_with_duration():
+    from jepsen_tpu.checker.perf import adaptive_dt
+
+    def hist_of(seconds):
+        return [{"time": int(seconds * 1e9), "type": "ok", "f": "r",
+                 "process": 0, "value": None}]
+
+    assert adaptive_dt(hist_of(60)) == 1       # 1 min test: 1s buckets
+    assert adaptive_dt(hist_of(600)) == 10     # 10 min: 10s
+    assert adaptive_dt(hist_of(86400)) == 1800  # day-long soak
+    assert adaptive_dt([]) == 1
+
+
+def test_dense_point_series_render_translucent():
+    from jepsen_tpu import plot as gp
+
+    dense = gp.Plot(series=[gp.Series(
+        title="d", data=[(i, i % 7) for i in range(gp.DENSE_POINTS + 1)],
+        mode="points")])
+    # fill-opacity (a presentation attribute, applied per marker) —
+    # NOT group `opacity`, which would composite the layer as one unit
+    # and flatten the overlaps the translucency exists to show
+    assert f'fill-opacity="{gp.DENSE_ALPHA}"' in gp.render(dense)
+    sparse = gp.Plot(series=[gp.Series(
+        title="s", data=[(0, 1), (1, 2)], mode="points")])
+    assert f'opacity="{gp.DENSE_ALPHA}"' not in gp.render(sparse)
