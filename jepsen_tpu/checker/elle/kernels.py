@@ -47,6 +47,26 @@ MASK_SETS = {
 SET_MASK = {s: m for m, s in MASK_SETS.items()}
 
 
+def edge_accumulator():
+    """(acc, add) for the graph builders' hot path: `add(i, j, bit)`
+    ORs edge-type bits into an {(i, j): mask} dict with no per-edge
+    set allocation.  Convert with `mask_edges_to_sets` at the boundary
+    where consumers expect {(i, j): {'ww', ...}}."""
+    acc: dict[tuple, int] = {}
+    _get = acc.get
+
+    def add(i, j, bit):
+        if i != j:
+            key = (i, j)
+            acc[key] = _get(key, 0) | bit
+
+    return acc, add
+
+
+def mask_edges_to_sets(acc: dict) -> dict:
+    return {k: MASK_SETS[m] for k, m in acc.items()}
+
+
 def type_mask(types) -> int:
     """Edge types (frozenset/set of names, or an int mask) -> int mask."""
     if isinstance(types, int):
@@ -337,13 +357,16 @@ def analyze_edges(n: int, edges: dict, mesh=None,
         return out
 
     m = len(plain)
-    src = np.empty(m, np.int64)
-    dst = np.empty(m, np.int64)
-    tmask = np.zeros(m, np.uint8)
-    for ix, ((i, j), types) in enumerate(plain.items()):
-        src[ix] = i
-        dst[ix] = j
-        tmask[ix] = type_mask(types)
+    src = np.fromiter((k[0] for k in plain), np.int64, count=m)
+    dst = np.fromiter((k[1] for k in plain), np.int64, count=m)
+    try:
+        # fast path: graph builders emit the shared frozensets, which
+        # hash straight back to their masks
+        tmask = np.fromiter((SET_MASK[t] for t in plain.values()),
+                            np.uint8, count=m)
+    except (KeyError, TypeError):   # foreign set objects / masks
+        tmask = np.fromiter((type_mask(t) for t in plain.values()),
+                            np.uint8, count=m)
 
     labels = scc_labels(n, src, dst)
     sizes = np.bincount(labels)

@@ -44,7 +44,6 @@ def _is_append(m) -> bool:
 # the canonical bits and the mask -> shared-frozenset table in the
 # {(i, j): {'ww', ...}} shape the cycle analyzers consume
 _WW, _WR, _RW = kernels._WW, kernels._WR, kernels._RW
-_MASK_SETS = kernels.MASK_SETS
 
 
 def op_internal_case(op: dict) -> dict | None:
@@ -188,17 +187,10 @@ def graph(hist):
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    # hot path (~5 calls per op on 100k-txn histories): accumulate edge
-    # types as an int bitmask — no per-edge set allocation — and convert
-    # to the {(i, j): {type, ...}} shape consumers read once, at the
-    # end, through a 7-entry shared-frozenset table
-    acc: dict[tuple, int] = {}
-    _get = acc.get
-
-    def add(i, j, bit):
-        if i != j:
-            key = (i, j)
-            acc[key] = _get(key, 0) | bit
+    # hot path (~5 calls per op on 100k-txn histories): bitmask edge
+    # accumulation, converted once at the end to the {(i, j): {type,
+    # ...}} shape consumers read (kernels owns the representation)
+    acc, add = kernels.edge_accumulator()
 
     orders, incompatible = a.version_orders()
     # ww along each key's observed version chain
@@ -248,7 +240,7 @@ def graph(hist):
             for wop in unobserved.get(k, ()):
                 if id(wop) != id(o):
                     add(i_reader, idx[id(wop)], _RW)
-    edges = {k: _MASK_SETS[m] for k, m in acc.items()}
+    edges = kernels.mask_edges_to_sets(acc)
     return txns, edges, a, incompatible
 
 

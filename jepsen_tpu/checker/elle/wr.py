@@ -31,7 +31,6 @@ from ...history import history as as_history, is_fail, is_info, is_ok
 from . import kernels
 
 _WW, _WR, _RW = kernels._WW, kernels._WR, kernels._RW
-_MASK_SETS = kernels.MASK_SETS
 
 _INIT = object()  # the unwritten initial state (reads return None)
 
@@ -134,15 +133,8 @@ def graph(hist):
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    # same bitmask accumulation as list_append.graph: no per-edge set
-    # allocation on the hot path, one conversion at the end
-    acc: dict[tuple, int] = {}
-    _get = acc.get
-
-    def add(i, j, bit):
-        if i != j:
-            key = (i, j)
-            acc[key] = _get(key, 0) | bit
+    # bitmask edge accumulation (kernels owns the representation)
+    acc, add = kernels.edge_accumulator()
 
     # wr: writer -> external readers (exact)
     for o in a.oks:
@@ -185,7 +177,7 @@ def graph(hist):
                     w2 = a.writer_of.get((k, v2))
                     if w2 is not None:
                         add(idx[id(o)], idx[id(w2[0])], _RW)
-    edges = {k: _MASK_SETS[m] for k, m in acc.items()}
+    edges = kernels.mask_edges_to_sets(acc)
     return txns, edges, a
 
 

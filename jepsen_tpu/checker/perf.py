@@ -255,14 +255,17 @@ def point_graph(test, hist, opts=None) -> Optional[str]:
 NICE_DTS = (1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, 3600)
 
 
-def adaptive_dt(hist, target_buckets: int = 60) -> float:
+def adaptive_dt(hist, target_buckets: int = 60,
+                t_max: float | None = None) -> float:
     """Bucket width giving ~target_buckets windows over the history's
     duration, snapped to a human-friendly step.  Fixed 30 s windows (the
     reference's default) flatten a one-minute test into two points and
     oversample a day-long soak; adapting to point density is its
-    plan.md "adaptive temporal resolution" item."""
-    t_max = util.nanos_to_secs(max((o.get("time", 0) for o in hist),
-                                   default=0))
+    plan.md "adaptive temporal resolution" item.  Pass t_max (seconds)
+    when the caller already scanned the history for it."""
+    if t_max is None:
+        t_max = util.nanos_to_secs(max((o.get("time", 0) for o in hist),
+                                       default=0))
     want = t_max / max(target_buckets, 1)
     for dt in NICE_DTS:
         if dt >= want:
@@ -303,10 +306,10 @@ def rate_graph(test, hist, opts=None, dt: float | None = None
     nemesis completions are excluded (`perf.clj:559-599`).  dt=None
     picks an adaptive width."""
     hist = history(hist)
-    if dt is None:
-        dt = adaptive_dt(hist)
     t_max = util.nanos_to_secs(max((o.get("time", 0) for o in hist),
                                    default=0))
+    if dt is None:
+        dt = adaptive_dt(hist, t_max=t_max)
     datasets: dict = {}
     for o in hist:
         if is_invoke(o) or not isinstance(o.get("process"), int):

@@ -93,47 +93,59 @@ class Context:
     # the interpreter's per-op hot path (>20k ops/s parity target,
     # `generator.clj:66-70`), and replace() re-walks the signature.
 
-    def with_time(self, t: int) -> "Context":
-        c = Context(t, self.free_threads, self.workers)
-        # restrictions are time-independent: share the memo
+    def _share_workers_cache(self, c: "Context") -> "Context":
+        # the pred -> filtered-workers memo depends only on `workers`,
+        # so every transition that keeps the same workers dict (time,
+        # busy, free) carries it forward — across a whole run the
+        # filter is computed once per pred per workers generation, not
+        # once per event
         try:
-            object.__setattr__(c, "_restrict_cache",
-                               self._restrict_cache)
+            object.__setattr__(c, "_workers_cache", self._workers_cache)
         except AttributeError:
             pass
         return c
 
+    def with_time(self, t: int) -> "Context":
+        return self._share_workers_cache(
+            Context(t, self.free_threads, self.workers))
+
     def busy(self, thread) -> "Context":
-        return Context(self.time,
-                       tuple(t for t in self.free_threads if t != thread),
-                       self.workers)
+        return self._share_workers_cache(Context(
+            self.time,
+            tuple(t for t in self.free_threads if t != thread),
+            self.workers))
 
     def free(self, thread) -> "Context":
         if thread in self.free_threads:
             return self
-        return Context(self.time, self.free_threads + (thread,),
-                       self.workers)
+        return self._share_workers_cache(Context(
+            self.time, self.free_threads + (thread,), self.workers))
 
     def with_workers(self, workers: dict) -> "Context":
+        # deliberately does NOT share the memo: workers changed
         return Context(self.time, self.free_threads, workers)
 
     def restrict(self, key, pred) -> "Context":
-        """A view containing only threads satisfying pred. The
-        (free-threads, workers) filtering is memoized per pred on this
-        context (and shared through with_time, which changes neither):
-        thread-routing combinators re-restrict the same context many
-        times per op."""
+        """A view containing only threads satisfying pred. The workers
+        filtering is memoized per pred and survives time/busy/free
+        transitions (thread-routing combinators re-restrict evolving
+        contexts on every event); only the free-thread filter — a
+        handful of pred calls — runs per restriction. The restricted
+        context gets a fresh memo of its own: its workers are a
+        subset, so inherited entries would be wrong for nested
+        restrictions."""
         try:
-            cache = self._restrict_cache
+            cache = self._workers_cache
         except AttributeError:
             cache = {}
-            object.__setattr__(self, "_restrict_cache", cache)
-        got = cache.get(key)
-        if got is None:
-            got = (tuple(t for t in self.free_threads if pred(t)),
-                   {t: p for t, p in self.workers.items() if pred(t)})
-            cache[key] = got
-        return Context(self.time, got[0], got[1])
+            object.__setattr__(self, "_workers_cache", cache)
+        w = cache.get(key)
+        if w is None:
+            w = {t: p for t, p in self.workers.items() if pred(t)}
+            cache[key] = w
+        return Context(self.time,
+                       tuple(t for t in self.free_threads if pred(t)),
+                       w)
 
 
 def context(test: dict) -> Context:
@@ -276,6 +288,13 @@ def op(gen, test: dict, ctx: Context):
             x = _call_fn_gen(gen, test, ctx)
             if x is None:
                 return None
+            if type(x) is dict:
+                # fast path for the ubiquitous fn-gen -> op-dict case:
+                # inline the [dict, fn] list+dict dispatch this would
+                # otherwise recurse through (the >20k ops/s parity
+                # target lives here, `generator.clj:66-70`)
+                o = fill_in_op(x, ctx)
+                return (o, [x, gen]) if o is PENDING else (o, gen)
             return op([x, gen], test, ctx)
         if isinstance(gen, (list, tuple)):
             if not gen:

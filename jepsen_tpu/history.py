@@ -107,11 +107,12 @@ class History(Sequence):
     checker needs: indexing, invoke/completion pairing, filtering.
     """
 
-    __slots__ = ("ops", "_pair_index")
+    __slots__ = ("ops", "_pair_index", "_indexed")
 
     def __init__(self, ops: Iterable[dict]):
         self.ops = list(ops)
         self._pair_index: dict[int, int] | None = None
+        self._indexed = False
 
     # -- Sequence interface -------------------------------------------------
     def __len__(self) -> int:
@@ -142,7 +143,10 @@ class History(Sequence):
         that already have correct indices are reused; a fully-indexed
         history returns itself (re-indexing a 100k-op history costs
         half a second of pure dict traffic)."""
+        if self._indexed:
+            return self   # verified (or built) by an earlier call
         if all(o.get("index") == i for i, o in enumerate(self.ops)):
+            self._indexed = True
             return self
         out = []
         for i, o in enumerate(self.ops):
@@ -150,7 +154,9 @@ class History(Sequence):
                 o = dict(o)
                 o["index"] = i
             out.append(o)
-        return History(out)
+        h = History(out)
+        h._indexed = True
+        return h
 
     def pair_index(self) -> dict[int, int]:
         """Map from op position -> position of its partner (invoke <->
