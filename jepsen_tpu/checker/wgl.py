@@ -52,6 +52,7 @@ import collections
 import dataclasses
 import functools
 import heapq
+import os
 import time as _time
 from typing import Callable
 
@@ -763,14 +764,18 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
     f32 = jnp.float32
     s_vals = s_lo + np.arange(S, dtype=np.int32)           # (S,)
     cols = np.arange(C, dtype=np.int32)                    # (C,)
-    idx_xor = cols[None, :] ^ (1 << np.arange(P))[:, None]  # (P, C) c^bit
-    has_bit = ((cols[None, :] >> np.arange(P)[:, None]) & 1).astype(bool)
 
     S_VALS = jnp.asarray(s_vals)
-    IDX_XOR = jnp.asarray(idx_xor)
-    HAS_BIT = jnp.asarray(has_bit)
     COLS = jnp.asarray(cols)
     ARANGE_P = jnp.arange(P)
+
+    pallas_round = None
+    if os.environ.get("JEPSEN_TPU_PALLAS_CLOSURE") == "1":
+        from . import wgl_pallas
+        if wgl_pallas.eligible(S, P):
+            # interpret mode off-TPU: the flag stays testable anywhere
+            pallas_round = wgl_pallas.closure_round_fn(
+                S, P, interpret=jax.default_backend() != "tpu")
 
     def closure(table, slot_f, slot_a, slot_b, slot_occ):
         """Close the table under linearization of every occupied slot."""
@@ -781,6 +786,25 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
         M = (legal[:, :, None]
              & (new[:, :, None] == S_VALS[None, None, :]))      # (P,S,S2)
         Mf = M.astype(f32)
+
+        if pallas_round is not None:
+            # fused VMEM round (opt-in): transition product + butterfly
+            # + OR-accumulate in one kernel, no HBM intermediates
+            MfT = jnp.swapaxes(Mf, 1, 2)
+
+            def pcond(c):
+                _tb, cnt, prev = c
+                return cnt != prev
+
+            def pbody(c):
+                tb, cnt, _ = c
+                tb = pallas_round(tb, MfT)
+                return tb, tb.sum().astype(i32), cnt
+
+            tbf, _, _ = lax.while_loop(
+                pcond, pbody,
+                (table.astype(f32), table.sum().astype(i32), i32(-1)))
+            return tbf > 0
 
         # fixpoint: iterate while the popcount grows. M (the P x S x S
         # transition tensor) is computed once per invoke above, outside
@@ -794,11 +818,17 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
             tb, cnt, _ = c
             moved = jnp.einsum("psq,sc->pqc", Mf,
                                tb.astype(f32)) > 0               # (P,S2,C)
-            # destination (s2, c-with-bit) comes from source col c^bit
-            shifted = jnp.take_along_axis(
-                moved, IDX_XOR[:, None, :], axis=2)              # (P,S2,C)
-            cand = shifted & HAS_BIT[:, None, :]
-            tb = tb | cand.any(axis=0)
+            # destination (s2, c | bit_p) comes from source col c (bit_p
+            # clear): a butterfly along the mask axis — per-p static
+            # reshape + concat, which XLA lowers as layout moves instead
+            # of the lane gather take_along_axis would emit
+            for p in range(P):
+                b = 1 << p
+                m = moved[p].reshape(S, C // (2 * b), 2, b)
+                cand = jnp.concatenate(
+                    [jnp.zeros_like(m[:, :, :1, :]), m[:, :, :1, :]],
+                    axis=2)
+                tb = tb | cand.reshape(S, C)
             return tb, tb.sum().astype(i32), cnt
 
         table, _, _ = lax.while_loop(

@@ -1,0 +1,81 @@
+"""Pallas TPU kernel for the dense WGL closure round.
+
+The dense engine's hot op is one closure round over the configuration
+table: for every pending slot p,
+
+    moved[q, c] = OR_s  M[p, s, q] AND table[s, c]        (transition)
+    table      |= butterfly_p(moved)                      (set bit p)
+
+XLA already fuses the einsum + butterfly well (`wgl.py:_dense_kernel`),
+but the (P, S, C) `moved` intermediate can spill to HBM between the
+product and the butterfly.  This kernel keeps the whole round in VMEM —
+the table is at most DENSE_TABLE_CAP (= 2^22) bools, well under the
+~16 MB VMEM budget — computing the P transition products and the
+OR-accumulate in one pass with zero HBM round-trips.
+
+Status: OPT-IN (set JEPSEN_TPU_PALLAS_CLOSURE=1).  The XLA path remains
+the default until the compiled kernel has been timed on real hardware;
+correctness is pinned against the XLA formulation by
+tests/test_wgl_pallas.py in pallas interpret mode.  Eligibility: the
+mask axis must fill the 128-lane tile (P >= 7) and the padded state
+axis must be a multiple of 8.
+"""
+
+from __future__ import annotations
+
+import functools
+
+MIN_P_FOR_LANES = 7       # C = 2^P must be a multiple of 128
+SUBLANE = 8               # f32 tile: (8, 128) — S must align
+# three (S, C) f32 live tensors (tb, moved, acc) + mft + headroom must
+# fit VMEM (~16 MB); cap the table itself well below that
+MAX_TABLE_BYTES = 4 << 20
+
+
+def eligible(S: int, P: int) -> bool:
+    return (P >= MIN_P_FOR_LANES
+            and S % SUBLANE == 0
+            and S * (1 << P) * 4 <= MAX_TABLE_BYTES)
+
+
+@functools.lru_cache(maxsize=16)
+def closure_round_fn(S: int, P: int, interpret: bool = False):
+    """Build `round(table_f32 (S,C), mft_f32 (P,S,S)) -> table_f32` —
+    one fused closure round.  mft holds the TRANSPOSED transition
+    matrices (mft[p] = M[p].T) so the in-kernel product is a plain
+    matmul feeding the MXU."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C = 1 << P
+
+    def kernel(tb_ref, mft_ref, out_ref):
+        tb = tb_ref[:]                                    # (S, C)
+        acc = tb
+        for p in range(P):                                # static unroll
+            moved = jax.lax.dot(
+                mft_ref[p], tb,
+                preferred_element_type=jnp.float32)       # (S, C)
+            moved = (moved > 0.0).astype(jnp.float32)
+            b = 1 << p
+            m4 = moved.reshape(S, C // (2 * b), 2, b)
+            cand = jnp.concatenate(
+                [jnp.zeros_like(m4[:, :, :1, :]), m4[:, :, :1, :]],
+                axis=2).reshape(S, C)
+            acc = jnp.maximum(acc, cand)
+        out_ref[:] = acc
+
+    @jax.jit
+    def closure_round(table, mft):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((S, C), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(table, mft)
+
+    return closure_round

@@ -1,0 +1,90 @@
+"""Pallas closure-round kernel: correctness pins against the XLA
+formulation (interpret mode — no TPU needed), plus the env-gated
+end-to-end path through the dense engine."""
+
+import os
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models
+from jepsen_tpu.checker import synth
+from jepsen_tpu.checker.wgl import _dense_kernel, analysis_tpu
+from jepsen_tpu.checker import wgl_pallas
+
+
+def _xla_round(tb, mf):
+    """Independent oracle: the XOR-gather formulation (the dense
+    engine's original take_along_axis shape) — deliberately NOT the
+    butterfly reshape the pallas kernel uses, so a shared butterfly
+    indexing bug cannot cancel out."""
+    import jax.numpy as jnp
+
+    P, S, _ = mf.shape
+    C = tb.shape[1]
+    cols = np.arange(C, dtype=np.int32)
+    idx_xor = jnp.asarray(cols[None, :] ^ (1 << np.arange(P))[:, None])
+    has_bit = jnp.asarray(
+        ((cols[None, :] >> np.arange(P)[:, None]) & 1).astype(bool))
+    moved = jnp.einsum("psq,sc->pqc", mf, tb.astype(jnp.float32)) > 0
+    shifted = jnp.take_along_axis(moved, idx_xor[:, None, :], axis=2)
+    cand = shifted & has_bit[:, None, :]
+    return tb.astype(bool) | cand.any(axis=0)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("S,P", [(16, 7), (30, 8)])
+def test_closure_round_matches_xla(S, P, seed):
+    import jax.numpy as jnp
+
+    C = 1 << P
+    rng = np.random.default_rng(seed)
+    tb = jnp.asarray(rng.random((S, C)) > 0.9)
+    mf = jnp.asarray((rng.random((P, S, S)) > 0.85).astype(np.float32))
+
+    want = np.asarray(_xla_round(tb, mf))
+    fn = wgl_pallas.closure_round_fn(S, P, interpret=True)
+    got = np.asarray(
+        fn(tb.astype(jnp.float32), jnp.swapaxes(mf, 1, 2))) > 0
+    assert (got == want).all()
+
+
+def test_eligibility_bounds():
+    assert not wgl_pallas.eligible(32, 6)   # C=64: under one lane tile
+    assert wgl_pallas.eligible(32, 7)
+    assert not wgl_pallas.eligible(30, 7)   # S not sublane-aligned
+    assert not wgl_pallas.eligible(32, 16)  # 32*2^16*4 = 8 MB: too big
+    assert wgl_pallas.eligible(32, 15)      # exactly at the 4 MB cap
+
+
+def test_dense_engine_end_to_end_with_pallas_round(monkeypatch):
+    """Env-gated: the dense engine must produce identical verdicts with
+    the pallas round (interpret mode off-TPU)."""
+    monkeypatch.setenv("JEPSEN_TPU_PALLAS_CLOSURE", "1")
+    built = []
+    orig_fn = wgl_pallas.closure_round_fn
+
+    def counting(S, P, interpret=False):
+        built.append((S, P))
+        return orig_fn(S, P, interpret=interpret)
+
+    monkeypatch.setattr(wgl_pallas, "closure_round_fn", counting)
+    _dense_kernel.cache_clear()
+    try:
+        model = models.cas_register()
+        # values=5 -> S buckets to 8 (sublane-aligned), concurrency 10
+        # -> p_exact 11 >= 7: eligible. Tiny history: interpret mode
+        # costs ~ms per round
+        h = synth.register_history(60, concurrency=10, values=5,
+                                   crash_rate=0.1, seed=45100)
+        a = analysis_tpu(model, h, engine="dense")
+        assert a["analyzer"] == "tpu-wgl-dense"
+        assert built, "pallas round was never engaged (eligibility?)"
+        b_env = os.environ.pop("JEPSEN_TPU_PALLAS_CLOSURE")
+        _dense_kernel.cache_clear()
+        b = analysis_tpu(model, h, engine="dense")
+        os.environ["JEPSEN_TPU_PALLAS_CLOSURE"] = b_env
+        assert a["valid?"] == b["valid?"]
+        assert a.get("op-count") == b.get("op-count")
+    finally:
+        _dense_kernel.cache_clear()
