@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import numpy as np
 
@@ -211,11 +212,53 @@ def _flags_batch_fn(e: int, steps: int):
     return batch
 
 
+def _classify_batches_host(buckets: dict) -> dict:
+    """Host path of the batched classifier (same contract as
+    `_classify_batches`): per-SCC dense blocks -> four flag vectors.
+    Selected by `JEPSEN_TPU_ELLE_HOST=1` when the device path is
+    unavailable — the axon relay can wedge mid-session and lose a
+    dispatch forever (r05: the first elle device compile hung while
+    every WGL kernel ran; the surviving process held the chip grant),
+    so a correctness verdict must never *require* the device. Exact:
+    closure by boolean repeated squaring mirrors the device kernel."""
+    out: dict = {}
+    for e, (ww, wr, rw) in sorted(buckets.items()):
+        b = ww.shape[0]
+        flags = (np.zeros(b, bool), np.zeros(b, bool),
+                 np.zeros(b, bool), np.zeros(b, bool))
+        steps = max(1, math.ceil(math.log2(max(e, 2))))
+
+        def closure(a):
+            a = a.copy()
+            for _ in range(steps):
+                a = np.minimum(a + a @ a, 1.0)
+            return a
+
+        for s in range(b):
+            c_ww = closure(ww[s])
+            c_wwr = closure(np.minimum(ww[s] + wr[s], 1.0))
+            c_full = closure(np.minimum(ww[s] + wr[s] + rw[s], 1.0))
+            eye = np.eye(e)
+            ec = np.minimum(c_wwr + eye, 1.0)
+            h1 = np.minimum(ec @ rw[s] @ ec, 1.0)
+            cr = np.maximum(c_full, eye)
+            p = np.minimum(rw[s] @ cr, 1.0)
+            flags[0][s] = bool(np.diag(c_ww).any())
+            flags[1][s] = bool(np.diag(c_wwr).any())
+            flags[2][s] = bool(np.diag(h1).any())
+            flags[3][s] = bool(((p * p.T) * (1.0 - eye) > 0).any())
+        out[e] = flags
+    return out
+
+
 def _classify_batches(buckets: dict, mesh=None) -> dict:
     """Run the batched classifier per bucket size. buckets maps
     e -> (ww[B,e,e], wr, rw) float32 numpy. Returns
     e -> (g0[B], g1c[B], single[B], g2[B]) bool numpy — per-SCC flags,
     in the caller's slot order."""
+    if os.environ.get("JEPSEN_TPU_ELLE_HOST") == "1":
+        return _classify_batches_host(buckets)
+
     import jax
     import jax.numpy as jnp
 

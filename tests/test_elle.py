@@ -416,6 +416,38 @@ def test_analyze_edges_sharded_mesh():
     assert "G1c" in res["anomaly-types"]
 
 
+def test_classify_batches_host_parity(monkeypatch):
+    # the JEPSEN_TPU_ELLE_HOST=1 fallback (used when the device relay
+    # is wedged, bench.py section_config5) must agree flag-for-flag
+    # with the device kernel on random SCC blocks — so make sure the
+    # "device" side really takes the device path
+    monkeypatch.delenv("JEPSEN_TPU_ELLE_HOST", raising=False)
+    rng = np.random.default_rng(11)
+    buckets = {}
+    for e in (8, 16):
+        b = 6
+        mats = []
+        for _ in range(3):
+            m = (rng.random((b, e, e)) < 0.15).astype(np.float32)
+            for s in range(b):
+                np.fill_diagonal(m[s], 0.0)
+            mats.append(m)
+        buckets[e] = tuple(mats)
+    dev = kernels._classify_batches(buckets)
+    host = kernels._classify_batches_host(buckets)
+    for e in buckets:
+        for d, h in zip(dev[e], host[e]):
+            assert (np.asarray(d) == np.asarray(h)).all()
+
+
+def test_check_host_classify_env(monkeypatch):
+    from jepsen_tpu.checker import synth
+    monkeypatch.setenv("JEPSEN_TPU_ELLE_HOST", "1")
+    h = synth.inject_append_cycles(synth.append_history(300), 7, "G1c")
+    res = list_append.check(h)
+    assert res["valid?"] is False and "G1c" in res["anomaly-types"]
+
+
 def test_analyze_edges_oversized_scc_host_path():
     # force the oversized path with a tiny max_dense: a 4-node G1c ring
     # plus a disjoint 2-node G0 ring
