@@ -89,14 +89,18 @@ class _Analysis:
         self.oks = [o for o in hist if is_ok(o)]
         self.infos = [o for o in hist if is_info(o)]
         self.fails = [o for o in hist if is_fail(o)]
-        # writer_of[k][v] -> (op, final?) for ok/info appends
+        # txns is the graph's node order; writer_of[k][v] -> (txn index,
+        # final?) for ok/info appends.  Indices (not op objects) keep
+        # the 100k-txn hot loops free of id()-keyed lookups — an ok
+        # writer is exactly an index < len(self.oks).
+        self.txns = self.oks + self.infos
         self.writer_of: dict[Any, dict[Any, tuple]] = {}
         self.duplicates: list = []
-        for o in self.oks + self.infos:
+        for ti, o in enumerate(self.txns):
             appended: dict[Any, list] = {}
             val = o.get("value")
-            if is_info(o) and not isinstance(val, (list, tuple)):
-                continue  # crashed before we knew the txn
+            if ti >= len(self.oks) and not isinstance(val, (list, tuple)):
+                continue  # info op that crashed before we knew the txn
             for m in val or ():
                 if m[0] == "append":
                     appended.setdefault(m[1], []).append(m[2])
@@ -106,8 +110,8 @@ class _Analysis:
                     if v in w:
                         self.duplicates.append(
                             {"key": k, "value": v,
-                             "ops": [w[v][0], o]})
-                    w[v] = (o, i == len(vs) - 1)
+                             "ops": [self.txns[w[v][0]], o]})
+                    w[v] = (ti, i == len(vs) - 1)
         self.failed_writes = {
             (mop.key(m), mop.value(m)): o
             for o in self.fails
@@ -159,14 +163,16 @@ class _Analysis:
         """Reads whose final observed element is a non-final append of a
         multi-append txn (`intermediate read`)."""
         cases = []
-        for o in self.oks:
+        wo = self.writer_of
+        empty: dict = {}
+        for ri, o in enumerate(self.oks):
             for m in o.get("value") or ():
                 if m[0] == "r" and m[2]:
                     k, v = m[1], m[2][-1]
-                    w = self.writer_of.get(k, {}).get(v)
-                    if w is not None and not w[1] and id(w[0]) != id(o):
+                    w = wo.get(k, empty).get(v)
+                    if w is not None and not w[1] and w[0] != ri:
                         cases.append({"op": o, "mop": list(m),
-                                      "writer": w[0]})
+                                      "writer": self.txns[w[0]]})
         return cases
 
 
@@ -185,43 +191,47 @@ def graph(hist):
     anti-depends on every never-observed :ok append of its key (crashed
     never-observed appends may not have executed)."""
     a = _Analysis(hist)
-    txns = a.oks + a.infos
-    idx = {id(o): i for i, o in enumerate(txns)}
+    txns = a.txns
+    n_oks = len(a.oks)
     # hot path (~5 calls per op on 100k-txn histories): bitmask edge
     # accumulation, converted once at the end to the {(i, j): {type,
-    # ...}} shape consumers read (kernels owns the representation)
+    # ...}} shape consumers read (kernels owns the representation);
+    # writer_of holds txn INDICES, so no id()-keyed lookups anywhere
     acc, add = kernels.edge_accumulator()
 
     orders, incompatible = a.version_orders()
+    writer_of = a.writer_of
+    empty: dict = {}
     # ww along each key's observed version chain
     for k, chain in orders.items():
-        writers = a.writer_of.get(k, {})
+        writers = writer_of.get(k, empty)
+        wget = writers.get
         for v1, v2 in zip(chain, chain[1:]):
-            w1, w2 = writers.get(v1), writers.get(v2)
+            w1, w2 = wget(v1), wget(v2)
             if w1 and w2:
-                add(idx[id(w1[0])], idx[id(w2[0])], _WW)
-    # never-observed :ok appends per key (not in the longest chain)
+                add(w1[0], w2[0], _WW)
+    # never-observed :ok appends per key (not in the longest chain):
+    # ok txns are exactly indices < n_oks
     unobserved: dict[Any, list] = {}
-    for k, writers in a.writer_of.items():
+    for k, writers in writer_of.items():
         observed = set(orders.get(k, ()))
-        un = [wop for v, (wop, _f) in writers.items()
-              if v not in observed and is_ok(wop)]
+        un = [wi for v, (wi, _f) in writers.items()
+              if v not in observed and wi < n_oks]
         if un:
             unobserved[k] = un
     # wr + rw per read
-    for o in a.oks:
-        i_reader = idx[id(o)]
+    for i_reader, o in enumerate(a.oks):
         for m in o.get("value") or ():
             if m[0] != "r" or m[2] is None:
                 continue
             k = m[1]
-            vs = list(m[2])
-            writers = a.writer_of.get(k, {})
-            chain = orders.get(k, [])
+            vs = m[2]
+            writers = writer_of.get(k, empty)
+            chain = orders.get(k, ())
             if vs:
                 w = writers.get(vs[-1])
-                if w is not None and id(w[0]) != id(o):
-                    add(idx[id(w[0])], i_reader, _WR)
+                if w is not None and w[0] != i_reader:
+                    add(w[0], i_reader, _WR)
             # first in-chain successor with a known writer (observed =>
             # committed, so info writers count too). Versions with no
             # known writer — phantom values a corrupt store fabricated —
@@ -233,13 +243,13 @@ def graph(hist):
             while p < len(chain):
                 w2 = writers.get(chain[p])
                 if w2 is not None:
-                    if id(w2[0]) != id(o):
-                        add(i_reader, idx[id(w2[0])], _RW)
+                    if w2[0] != i_reader:
+                        add(i_reader, w2[0], _RW)
                     break
                 p += 1
-            for wop in unobserved.get(k, ()):
-                if id(wop) != id(o):
-                    add(i_reader, idx[id(wop)], _RW)
+            for wi in unobserved.get(k, ()):
+                if wi != i_reader:
+                    add(i_reader, wi, _RW)
     edges = kernels.mask_edges_to_sets(acc)
     return txns, edges, a, incompatible
 
