@@ -138,10 +138,29 @@ def preflight_backend():
     return False, {"attempts": attempts}
 
 
-N_OPS = 10_000
+def _env_int(name: str, default: int) -> int:
+    """Parse an int env override; a malformed value falls back to the
+    default with a stderr note — module import must never traceback,
+    or the one-parseable-JSON-line contract dies before main()."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _note(f"ignoring malformed {name}={raw!r}; using {default}")
+        return default
+
+
+# benchmark scales; env-overridable so orchestrator tests and smoke
+# runs stay fast (the driver's real runs never set these).  Overridden
+# scales are stamped into the output JSON (see main()) so a leaked
+# smoke-env artifact can never pass for a real 10k/100k run.
+DEFAULT_N_OPS, DEFAULT_N_TXNS = 10_000, 100_000
+N_OPS = _env_int("BENCH_N_OPS", DEFAULT_N_OPS)
 CONCURRENCY = 5
 BASELINE_OPS_PER_SEC = N_OPS / 3600.0  # CPU knossos: 1 h timeout on 10k ops
-N_TXNS = 100_000
+N_TXNS = _env_int("BENCH_N_TXNS", DEFAULT_N_TXNS)
 BASELINE_TXNS_PER_SEC = N_TXNS / 300.0  # north star: solved < 300 s
 # Host budget for the adversarial blowout measurement.  The north star
 # is "CPU knossos times out at 1 h" (checker.clj:213-216); a short
@@ -606,6 +625,8 @@ def main() -> int:
 
     extra["configs"] = configs
     extra["sections"] = sections_meta
+    if (N_OPS, N_TXNS) != (DEFAULT_N_OPS, DEFAULT_N_TXNS):
+        extra["scale_override"] = {"n_ops": N_OPS, "n_txns": N_TXNS}
     value = headline["value"] if headline else None
     out = {
         "metric": ("linearizability verification throughput, 10k-op "
