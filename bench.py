@@ -38,9 +38,9 @@ import subprocess
 import sys
 import time
 
-from jepsen_tpu._platform import honor_cpu_env
+from jepsen_tpu._platform import honor_platform_env
 
-honor_cpu_env()
+honor_platform_env()
 
 
 def _note(msg):
@@ -62,15 +62,18 @@ PREFLIGHT_TIMEOUT_S = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT_S", "75"))
 PREFLIGHT_BACKOFF_S = float(os.environ.get("BENCH_PREFLIGHT_BACKOFF_S", "45"))
 
 _PROBE_SRC = (
-    # sitecustomize may pre-bake the axon platform over JAX_PLATFORMS=cpu;
-    # re-assert the env choice (same dance as _platform.honor_cpu_env).
+    # sitecustomize may pre-bake the axon platform over any caller-set
+    # JAX_PLATFORMS (config beats env once the plugin registers);
+    # re-assert the env choice (same dance as
+    # _platform.honor_platform_env) so CPU probes stay hermetic and an
+    # invalid platform genuinely fails instead of reaching the chip.
     # The probe must DISPATCH, not just init: the relay can wedge at the
     # dispatch level while init still succeeds (r05: an elle compile
     # hung while jax.devices() answered), so an init-only probe would
     # green-light a backend that swallows real work.
     "import os, jax; "
-    "os.environ.get('JAX_PLATFORMS') == 'cpu' and "
-    "jax.config.update('jax_platforms', 'cpu'); "
+    "env = os.environ.get('JAX_PLATFORMS'); "
+    "env and jax.config.update('jax_platforms', env); "
     "ds = jax.devices(); "
     "import jax.numpy as jnp; "
     "y = (jnp.ones((8, 128)) @ jnp.ones((128, 128))).block_until_ready(); "
@@ -481,8 +484,11 @@ def _spawn_section(name: str, timeout_s: float, env=None):
     section pinned the grant and every subsequent `jax.devices()` hung
     at init until the holder was terminated).  Returns
     (rc|None, stdout, stderr, timed_out, seconds)."""
-    out_f = open(f"/tmp/bench_section_{name}.out", "w+")
-    err_f = open(f"/tmp/bench_section_{name}.err", "w+")
+    # pid-scoped paths: two orchestrators on one box (the live bench
+    # and the orchestrator e2e tests, say) must not truncate or read
+    # each other's section pipes
+    out_f = open(f"/tmp/bench_section_{os.getpid()}_{name}.out", "w+")
+    err_f = open(f"/tmp/bench_section_{os.getpid()}_{name}.err", "w+")
     t0 = time.monotonic()
     child = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__),
@@ -507,6 +513,15 @@ def _spawn_section(name: str, timeout_s: float, env=None):
     out_f.seek(0), err_f.seek(0)
     stdout, stderr = out_f.read(), err_f.read()
     out_f.close(), err_f.close()
+    if rc == 0 and not timed_out:
+        # pid-scoped so never re-truncated: unlink on success to bound
+        # /tmp growth; a failed/wedged section keeps its files as the
+        # postmortem artifact (the stderr tail in the JSON is 300 chars)
+        for f in (out_f, err_f):
+            try:
+                os.unlink(f.name)
+            except OSError:
+                pass
     return rc, stdout, stderr, timed_out, round(time.monotonic() - t0, 1)
 
 

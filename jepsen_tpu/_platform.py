@@ -1,18 +1,26 @@
 """Platform plumbing for driver entry scripts.
 
 Some interpreters pre-import jax via sitecustomize and bake a real-TPU
-platform into the live config, overriding a JAX_PLATFORMS=cpu set by
-the caller; `honor_cpu_env()` re-asserts the caller's choice so CPU
-dry-runs and smoke runs stay hermetic. (The test conftest goes further
-and forces CPU unconditionally.)"""
+platform into the live config, overriding any JAX_PLATFORMS set by the
+caller (config beats env once the plugin has registered);
+`honor_platform_env()` re-asserts the caller's choice so CPU dry-runs
+stay hermetic and a deliberately-invalid platform (how the bench tests
+simulate a dead backend) genuinely fails init instead of silently
+reaching the chip. (The test conftest goes further and forces CPU
+unconditionally.)"""
 
 from __future__ import annotations
 
 import os
 
 
-def honor_cpu_env() -> None:
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+def honor_platform_env() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if env:
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", env)
+
+
+# historical name, used by earlier entry scripts
+honor_cpu_env = honor_platform_env

@@ -30,9 +30,9 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from jepsen_tpu._platform import honor_cpu_env  # noqa: E402
+from jepsen_tpu._platform import honor_platform_env  # noqa: E402
 
-honor_cpu_env()
+honor_platform_env()
 
 
 def main() -> int:
