@@ -56,6 +56,10 @@ def test_degraded_mode_reports_host_numbers():
     assert c5["txns_per_s"] > 0
     assert c5["injected_cycle_classify"].startswith("host")
     assert out["extra"]["generator_ops_per_s"] > 0
+    # the committed hardware evidence rides along, clearly provenanced
+    lkg = out["extra"]["last_known_good_tpu_run"]
+    assert lkg["value"] > 0 and lkg["source"].startswith("doc/perf/")
+    assert "NOT" in lkg["note"]
     # device-only sections were skipped, not errored
     assert out["extra"]["sections"]["headline"] == {
         "skipped": "backend unavailable"}
