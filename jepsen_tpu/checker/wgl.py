@@ -1021,9 +1021,7 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
         if dense is not None:
             slots = dense[2]   # exact-P: the dense table is 2^P wide
         elif engine == "dense":
-            raise ValueError(
-                f"dense engine requested but the {srange} state range x "
-                f"2^{p_exact} table exceeds the dense caps")
+            raise _dense_caps_error(srange, p_exact)
     steps = build_steps(ops, slots)
     # capacity covers the unmerged stream so the blame re-run below
     # shares this compiled kernel
@@ -1168,11 +1166,76 @@ def _state_range(name: str, model, entries_list) -> tuple[int, int]:
     return int(lo), int(hi)
 
 
+def _slot_bucket(p: int, p_max: int | None = None) -> int:
+    """Bucket a slot count UP to the next even P so nearby keys share
+    one compiled kernel, floored at 4 (the smallest dense table worth
+    dispatching) and capped at the batch's true max so rounding never
+    exceeds what any key actually needs."""
+    pg = max(4, ((p + 1) // 2) * 2)
+    return min(pg, p_max) if p_max is not None else pg
+
+
+def _dense_caps_error(srange, p: int, key=None) -> ValueError:
+    """The forced-dense contract violation (one message, three raise
+    sites: scalar, batch plain path, batch group split)."""
+    who = f"key {key}'s" if key is not None else "the"
+    return ValueError(
+        f"dense engine requested but {who} {srange} state range x "
+        f"2^{p} table exceeds the dense caps")
+
+
+def _unknown_result(ops, error: str, t0: float) -> dict:
+    """The batch paths' 'unknown' verdict shape (one definition so the
+    grouped and plain paths can't drift)."""
+    return {"valid?": "unknown", "analyzer": "tpu-wgl-batch",
+            "op-count": len(ops), "error": error,
+            "configs": [], "final-paths": [],
+            "duration-ms": (_time.monotonic() - t0) * 1e3}
+
+
+def _dispatch_groups(srange, p_req: list[int], engine: str):
+    """Partition a batch's key indices into slot-bucketed dense dispatch
+    groups plus one shared sort-frontier group.
+
+    The dense table is S * 2^P wide, so padding every key to the worst
+    key's slot count multiplies the whole batch's device work by
+    2^(Pmax - P_key); bucketing nearby keys into one compiled kernel
+    each recovers that while adding only a few sub-ms dispatches.
+    Dense-ineligible keys gain nothing from grouping (the sort frontier
+    isn't 2^P-sized), so they spill into a single sort group instead of
+    paying one sort-kernel compile per bucket — or, under a forced
+    dense engine, raise.
+
+    Returns (dense_groups: {P: (dense_shape, [key indices])},
+    sort_idx: [key indices])."""
+    if engine == "sort":
+        return {}, list(range(len(p_req)))
+    sort_idx: list[int] = []
+    dense_groups: dict[int, tuple[tuple, list[int]]] = {}
+    p_max = max(p_req)
+    for i, p in enumerate(p_req):
+        pg = _slot_bucket(p, p_max)
+        d = _dense_shape(srange, pg) or _dense_shape(srange, p)
+        if d is None:
+            if engine == "dense":
+                raise _dense_caps_error(srange, p, key=i)
+            sort_idx.append(i)
+        else:
+            if d[2] in dense_groups:
+                dense_groups[d[2]][1].append(i)
+            else:
+                dense_groups[d[2]] = (d, [i])
+    return dense_groups, sort_idx
+
+
 def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                        slots: int = 32, chunk_entries: int = 4096,
                        budget_s: float | None = None,
                        cancel=None, engine: str = "auto",
-                       max_frontier: int = 65536) -> list[dict]:
+                       max_frontier: int = 65536,
+                       _pre: list | None = None,
+                       _dense=False,
+                       _preq: list | None = None) -> list[dict]:
     """Check a batch of independent histories (e.g. per-key subhistories
     from the independent workload) in vmapped device calls. Long batches
     run as bounded-duration chunks with the vmapped frontier carried
@@ -1183,7 +1246,17 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     Escalation is batched: every overflow-suspect key re-runs together
     in one vmapped call at 4x the frontier (recursively), instead of
     degrading to serial per-key searches; likewise culprit-op blame for
-    definite invalids runs as one vmapped unmerged pass."""
+    definite invalids runs as one vmapped unmerged pass.
+
+    _pre: internal — pre-encoded OpArrays (one per history), passed by
+    the group-split recursion so each history is encoded exactly once.
+    _dense: internal — the group's dense shape from _dispatch_groups
+    (False = derive it here), so bucketed groups share the bucket's
+    compiled kernel instead of re-deriving a data-dependent shape from
+    the group-local state range. _preq: internal — the group's
+    required_slots values, already scanned by the parent (the
+    group-local state range is deliberately NOT passed: recomputing it
+    over a narrower group can make a spilled sort group dense-eligible)."""
     import jax
     import jax.numpy as jnp
 
@@ -1195,16 +1268,76 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         return max(0.0, budget_s - (_time.monotonic() - t0))
 
     name = model.device_model
+    pre = (_pre if _pre is not None
+           else [encode_ops_for_model(model, h) for h in hists])
+    _srange = _p_needs = None   # pre-pass reuse for the one-bucket case
+    if engine in ("auto", "dense") and len(hists) > 1 and _pre is None:
+        # Slot-bucketed dispatch groups (see _dispatch_groups): recurse
+        # per group — each group is then bucket-uniform and runs the
+        # plain batched path below. Dense groups run cheapest-first and
+        # the sort group last, so a pathological dense-ineligible key
+        # can only starve itself of budget, not the cheap keys.
+        p_req = [required_slots(ops) for ops in pre]
+        srange_all = _state_range(name, model, pre)
+        dense_groups, sort_idx = _dispatch_groups(srange_all, p_req,
+                                                  engine)
+        group_list = [dense_groups[pg] for pg in sorted(dense_groups)]
+        if sort_idx:
+            group_list.append((False, sort_idx))
+        if len(group_list) > 1:
+            grouped: list[dict | None] = [None] * len(hists)
+            for d, idx in group_list:
+                rem = _remaining()
+                if (rem == 0.0) or (cancel is not None and cancel()):
+                    # budget gone: report the remaining groups without
+                    # dispatching even one chunk for them
+                    for i in idx:
+                        grouped[i] = _unknown_result(
+                            pre[i], "batch budget exhausted/cancelled "
+                            "before this key's search started", t0)
+                    continue
+                sub = analysis_tpu_batch(
+                    model, [hists[i] for i in idx], frontier=frontier,
+                    slots=slots, chunk_entries=chunk_entries,
+                    budget_s=rem, cancel=cancel, engine=engine,
+                    max_frontier=max_frontier,
+                    _pre=[pre[i] for i in idx], _dense=d,
+                    _preq=[p_req[i] for i in idx])
+                for t, i in enumerate(idx):
+                    grouped[i] = sub[t]
+            return grouped
+        # one bucket: fall through to the plain path, reusing the
+        # pre-pass instead of rescanning every history
+        if group_list and group_list[0][0] is not False:
+            _dense = group_list[0][0]
+        else:
+            _srange, _p_needs = srange_all, dict(enumerate(p_req))
+
     results: list[dict | None] = [None] * len(hists)
-    encoded = []
-    for i, h in enumerate(hists):
-        encoded.append((i, encode_ops_for_model(model, h)))
+    encoded = list(enumerate(pre))
     items = []           # (orig index, ops, steps)
     if encoded:
-        srange = _state_range(name, model, [o for _, o in encoded])
-        p_needs = {i: required_slots(o) for i, o in encoded}
-        dense = _dense_shape(srange, max(p_needs.values())) \
-            if engine in ("auto", "dense") else None
+        if _dense is not False:
+            # the bucket's shape, shared group-wide; the group-local
+            # state range and slot needs would be dead recomputation
+            # (the dense kernel's shape carries both)
+            dense, srange, p_needs = _dense, None, None
+        else:
+            srange = (_srange if _srange is not None else
+                      _state_range(name, model, [o for _, o in encoded]))
+            if _p_needs is not None:
+                p_needs = _p_needs
+            elif _preq is not None:
+                p_needs = dict(enumerate(_preq))
+            else:
+                p_needs = {i: required_slots(o) for i, o in encoded}
+            dense = _dense_shape(srange, max(p_needs.values())) \
+                if engine in ("auto", "dense") else None
+            if dense is None and engine == "dense":
+                # same contract as the scalar path and the multi-key
+                # grouped split: a forced dense engine never silently
+                # degrades to the sort kernel
+                raise _dense_caps_error(srange, max(p_needs.values()))
         if dense is not None:
             slots = dense[2]
         for i, ops in encoded:
@@ -1216,6 +1349,15 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                     cancel=cancel, engine=engine)
             else:
                 items.append((i, ops, build_steps(ops, slots)))
+    if items and ((_remaining() == 0.0)
+                  or (cancel is not None and cancel())):
+        # budget already gone: report unknown without dispatching even
+        # the first chunk (the chunk loop below always runs one)
+        for i, ops, _st in items:
+            results[i] = _unknown_result(
+                ops, "batch budget exhausted/cancelled before "
+                "this key's search started", t0)
+        items = []
     if items:
         E = _bucket(max(max(event_count(ops) for _, ops, _ in items), 1))
         padded = [st.pad_to(E) for _, _, st in items]
@@ -1259,12 +1401,9 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         invalids = []    # definite invalid: blame together
         for j, (i, ops, st) in enumerate(items):
             if not bool(decided[j]):
-                results[i] = {
-                    "valid?": "unknown", "analyzer": "tpu-wgl-batch",
-                    "op-count": len(ops),
-                    "error": ("batch budget exhausted/cancelled before "
-                              "this key's search finished"),
-                    "configs": [], "final-paths": []}
+                results[i] = _unknown_result(
+                    ops, "batch budget exhausted/cancelled before "
+                    "this key's search finished", t0)
             elif bool(ok[j]):
                 results[i] = {
                     "valid?": True, "analyzer": "tpu-wgl-batch",
@@ -1311,13 +1450,10 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                     results[i] = sub[t]
             else:
                 for i, ops in suspects:
-                    results[i] = {
-                        "valid?": "unknown", "analyzer": "tpu-wgl-batch",
-                        "op-count": len(ops),
-                        "error": (f"frontier overflowed at {frontier}; "
-                                  f"escalation cap {max_frontier} "
-                                  "reached — verdict unknown"),
-                        "configs": [], "final-paths": []}
+                    results[i] = _unknown_result(
+                        ops, f"frontier overflowed at {frontier}; "
+                        f"escalation cap {max_frontier} reached — "
+                        "verdict unknown", t0)
     dur = (_time.monotonic() - t0) * 1e3
     for r in results:
         if r is not None:
@@ -1325,48 +1461,31 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     return results  # type: ignore[return-value]
 
 
-def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
-                        frontier: int = 1024, slots: int = 32,
-                        engine: str = "auto"):
-    """Shard a batch of independent histories across a device mesh and
-    reduce the aggregate verdict with a psum-OR over ICI.
+def _sharded_runner(name, dense, frontier, slots, srange, E, mesh, axis):
+    """The jitted, mesh-sharded batch checker for one kernel shape.
 
-    Returns (all_valid: bool, per_key_ok: np.ndarray[bool]). The per-key
-    verdicts stay sharded until fetched; the scalar verdict is computed
-    with an explicit collective so multi-chip runs never gather full
-    frontiers to one chip.
+    Cached on the full compilation key (kernel shape + mesh) so repeated
+    check_batch_sharded calls — and the several per-slot-bucket dispatch
+    groups inside one call — reuse one traced+compiled executable per
+    shape. A fresh closure per call would force shard_map to re-trace
+    and XLA to recompile every time, which on the remote-relay TPU costs
+    seconds per dispatch and was the bulk of the sharded path's wall
+    time. The dense kernel ignores frontier/slots/srange, so they are
+    normalized out of the cache key here — spurious misses can't be
+    reintroduced by a call site.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    name = model.device_model
-    if mesh is None:
-        devs = np.array(jax.devices())
-        mesh = Mesh(devs, (axis,))
-    n_dev = mesh.shape[axis]
-    k = len(hists)
-    if k == 0:
-        return True, np.zeros(0, bool)
-    pad_k = -(-k // n_dev) * n_dev
-
-    all_ops = [encode_ops_for_model(model, h) for h in hists]
-    # OpArray exposes the same f/a/b arrays _state_range reads, so
-    # eligibility costs no extra stream builds
-    srange = _state_range(name, model, all_ops)
-    dense = None
-    if engine in ("auto", "dense"):
-        dense = _dense_shape(
-            srange, max(required_slots(ops) for ops in all_ops))
     if dense is not None:
-        slots = dense[2]
-    steps_list = [build_steps(ops, slots) for ops in all_ops]
-    E = _bucket(max(max(st.n for st in steps_list), 1))
-    w = steps_list[0].w
-    padded = [st.pad_to(E) for st in steps_list]
-    padded += [Steps.empty(w, E)] * (pad_k - k)
+        frontier = slots = srange = None
+    return _sharded_runner_cached(name, dense, frontier, slots, srange,
+                                  E, mesh, axis)
 
+
+@functools.lru_cache(maxsize=256)
+def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
+                           mesh, axis):
+    import jax
     from functools import partial
+    from jax.sharding import PartitionSpec as P
 
     if dense is not None:
         check_batch = _dense_kernel(name, dense[0], dense[1],
@@ -1394,13 +1513,89 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
         total_bad = jax.lax.psum(bad, axis)
         return (total_bad == 0)[None], ok, overflow
 
-    all_ok, per_key, overflow = run(
-        jnp.asarray(np.stack([st.x for st in padded])),
-        jnp.asarray(np.asarray([st.n for st in padded], np.int32)),
-        jnp.asarray(np.full(pad_k, model.device_state(), np.int32)))
-    all_ok = bool(np.asarray(all_ok)[0])
-    per_key = np.asarray(per_key)[:k]
-    overflow = np.asarray(overflow)[:k]
+    return jax.jit(run)
+
+
+def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
+                        frontier: int = 1024, slots: int = 32,
+                        engine: str = "auto"):
+    """Shard a batch of independent histories across a device mesh and
+    reduce the aggregate verdict with a psum-OR over ICI.
+
+    Returns (all_valid: bool, per_key_ok: np.ndarray[bool]). The per-key
+    verdicts stay sharded until fetched; the scalar verdict is computed
+    with an explicit collective so multi-chip runs never gather full
+    frontiers to one chip.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    name = model.device_model
+    if mesh is None:
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs, (axis,))
+    n_dev = mesh.shape[axis]
+    k = len(hists)
+    if k == 0:
+        return True, np.zeros(0, bool)
+    pad_k = -(-k // n_dev) * n_dev
+
+    all_ops = [encode_ops_for_model(model, h) for h in hists]
+    # OpArray exposes the same f/a/b arrays _state_range reads, so
+    # eligibility costs no extra stream builds
+    srange = _state_range(name, model, all_ops)
+    p_req = [required_slots(ops) for ops in all_ops]
+
+    # Slot-bucketed dispatch groups (see _dispatch_groups): on the
+    # hazelcast bench shape (100 keys, ~2.5 crashes/key) the max-padded
+    # table sums to 14x the per-key need; grouping recovers it for a
+    # couple of extra sub-ms dispatches.
+    dense_groups, sort_idx = _dispatch_groups(srange, p_req, engine)
+
+    def run_group(idx: list[int], dense):
+        """One vmapped + mesh-sharded dispatch over the keys in idx."""
+        if dense is not None:
+            g_slots = dense[2]
+        else:
+            # the sort group sizes itself to its own keys — never below
+            # the caller's slots, never a SlotOverflow on a key the
+            # dense caps rejected
+            g_slots = max(slots, _bucket(max(p_req[i] for i in idx),
+                                         lo=8))
+        steps_list = [build_steps(all_ops[i], g_slots) for i in idx]
+        E = _bucket(max(max(st.n for st in steps_list), 1))
+        w = steps_list[0].w
+        gk = len(idx)
+        g_pad = -(-gk // n_dev) * n_dev
+        padded = [st.pad_to(E) for st in steps_list]
+        padded += [Steps.empty(w, E)] * (g_pad - gk)
+
+        run = _sharded_runner(name, dense, frontier, g_slots, srange,
+                              E, mesh, axis)
+        # async dispatch: return the device arrays unfetched so every
+        # group's kernel is enqueued before the first blocking fetch —
+        # on a remote relay each synchronous fetch is a full
+        # round-trip, so serializing dispatch+fetch per group would
+        # re-add the latency the grouping saved
+        all_ok_g, ok_g, ov_g = run(
+            jnp.asarray(np.stack([st.x for st in padded])),
+            jnp.asarray(np.asarray([st.n for st in padded], np.int32)),
+            jnp.asarray(np.full(g_pad, model.device_state(), np.int32)))
+        return all_ok_g, ok_g, ov_g
+
+    pending = [(idx, run_group(idx, d))
+               for d, idx in (dense_groups[pg]
+                              for pg in sorted(dense_groups))]
+    if sort_idx:
+        pending.append((sort_idx, run_group(sort_idx, None)))
+    per_key = np.zeros(k, bool)
+    overflow = np.zeros(k, bool)
+    all_ok = True
+    for idx, (all_ok_g, ok_g, ov_g) in pending:
+        all_ok &= bool(np.asarray(all_ok_g)[0])
+        per_key[idx] = np.asarray(ok_g)[:len(idx)]
+        overflow[idx] = np.asarray(ov_g)[:len(idx)]
     # An 'invalid' under frontier overflow is unsound (the witness config
     # may have been dropped): escalate those keys — together, as one
     # vmapped batch at 4x the frontier (recursing upward), never a

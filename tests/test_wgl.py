@@ -176,6 +176,47 @@ def test_sharded_over_mesh():
     assert not per_key[5] and per_key[[i for i in range(16) if i != 5]].all()
 
 
+def test_batch_mixed_slot_buckets_matches_scalar():
+    # keys spanning several slot buckets exercise the bucketed dispatch
+    # groups; verdicts must match the per-history scalar checker
+    hists = [synth.register_history(60, concurrency=3 + (i % 4) * 2,
+                                    values=5, crash_rate=0.02, seed=70 + i)
+             for i in range(8)]
+    hists.append(synth.corrupt(hists[3]))
+    rs = analysis_tpu_batch(m.cas_register(), hists)
+    scalar = [analysis_tpu(m.cas_register(), h) for h in hists]
+    assert [r["valid?"] for r in rs] == [s["valid?"] for s in scalar]
+    assert all("duration-ms" in r for r in rs)
+
+
+def test_batch_zero_budget_reports_unknown_without_dispatch():
+    hists = [synth.register_history(60, concurrency=3 + (i % 3) * 3,
+                                    seed=i) for i in range(6)]
+    rs = analysis_tpu_batch(m.cas_register(), hists, budget_s=0.0)
+    assert all(r["valid?"] == "unknown" for r in rs)
+    assert all("duration-ms" in r for r in rs)
+
+
+def test_sharded_mixed_slot_buckets():
+    hists = [synth.register_history(50, concurrency=3 + (i % 5),
+                                    values=5, crash_rate=0.01, seed=200 + i)
+             for i in range(12)]
+    all_ok, per_key = check_batch_sharded(m.cas_register(), hists, slots=16)
+    assert all_ok and per_key.all()
+    hists.append(synth.corrupt(hists[0], seed=3))
+    all_ok, per_key = check_batch_sharded(m.cas_register(), hists, slots=16)
+    assert not all_ok and not per_key[-1] and per_key[:-1].all()
+
+
+def test_sharded_forced_sort_sizes_own_slots():
+    # a key needing more slots than the caller passed must not blow up
+    hists = [synth.register_history(40, concurrency=7, seed=s)
+             for s in range(4)]
+    all_ok, per_key = check_batch_sharded(m.cas_register(), hists,
+                                          slots=4, engine="sort")
+    assert all_ok and per_key.all()
+
+
 # -- slot machinery -----------------------------------------------------------
 
 def test_slot_overflow_detection():
@@ -565,6 +606,15 @@ def test_forced_dense_engine_error_still_surfaces():
     big[1] = {**big[1], "value": 10**6}
     with pytest.raises(ValueError, match="dense"):
         analysis_tpu(m.cas_register(), History(big), engine="dense")
+    # the batch path honors the same contract for single- and multi-key
+    # batches (single-key skips the grouped split; multi-key raises
+    # inside _dispatch_groups)
+    with pytest.raises(ValueError, match="dense"):
+        analysis_tpu_batch(m.cas_register(), [History(big)],
+                           engine="dense")
+    with pytest.raises(ValueError, match="dense"):
+        analysis_tpu_batch(m.cas_register(), [History(big), h],
+                           engine="dense")
 
 
 # -- merged-step stream edge cases -------------------------------------------
