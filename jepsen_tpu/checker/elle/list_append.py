@@ -76,8 +76,18 @@ def op_internal_case(op: dict) -> dict | None:
 
 
 def internal_cases(hist) -> list:
-    return [c for o in hist if is_ok(o)
-            for c in [op_internal_case(o)] if c is not None]
+    # a txn needs at least two mops to disagree with itself; skipping
+    # the (common) single-mop txns saves two dict allocations each
+    # across a 100k-txn history
+    out = []
+    for o in hist:
+        if is_ok(o):
+            v = o.get("value")
+            if v is not None and len(v) > 1:
+                c = op_internal_case(o)
+                if c is not None:
+                    out.append(c)
+    return out
 
 
 class _Analysis:
@@ -194,10 +204,13 @@ def graph(hist):
     txns = a.txns
     n_oks = len(a.oks)
     # hot path (~5 calls per op on 100k-txn histories): bitmask edge
-    # accumulation, converted once at the end to the {(i, j): {type,
-    # ...}} shape consumers read (kernels owns the representation);
-    # writer_of holds txn INDICES, so no id()-keyed lookups anywhere
-    acc, add = kernels.edge_accumulator()
+    # accumulation inlined (an add() call per edge costs ~25% of the
+    # whole build at this scale), converted once at the end to the
+    # {(i, j): {type, ...}} shape consumers read (kernels owns the
+    # representation); writer_of holds txn INDICES, so no id()-keyed
+    # lookups anywhere
+    acc: dict[tuple, int] = {}
+    acc_get = acc.get
 
     orders, incompatible = a.version_orders()
     writer_of = a.writer_of
@@ -208,8 +221,9 @@ def graph(hist):
         wget = writers.get
         for v1, v2 in zip(chain, chain[1:]):
             w1, w2 = wget(v1), wget(v2)
-            if w1 and w2:
-                add(w1[0], w2[0], _WW)
+            if w1 and w2 and w1[0] != w2[0]:
+                key = (w1[0], w2[0])
+                acc[key] = acc_get(key, 0) | _WW
     # never-observed :ok appends per key (not in the longest chain):
     # ok txns are exactly indices < n_oks
     unobserved: dict[Any, list] = {}
@@ -231,7 +245,8 @@ def graph(hist):
             if vs:
                 w = writers.get(vs[-1])
                 if w is not None and w[0] != i_reader:
-                    add(w[0], i_reader, _WR)
+                    key = (w[0], i_reader)
+                    acc[key] = acc_get(key, 0) | _WR
             # first in-chain successor with a known writer (observed =>
             # committed, so info writers count too). Versions with no
             # known writer — phantom values a corrupt store fabricated —
@@ -244,12 +259,14 @@ def graph(hist):
                 w2 = writers.get(chain[p])
                 if w2 is not None:
                     if w2[0] != i_reader:
-                        add(i_reader, w2[0], _RW)
+                        key = (i_reader, w2[0])
+                        acc[key] = acc_get(key, 0) | _RW
                     break
                 p += 1
             for wi in unobserved.get(k, ()):
                 if wi != i_reader:
-                    add(i_reader, wi, _RW)
+                    key = (i_reader, wi)
+                    acc[key] = acc_get(key, 0) | _RW
     edges = kernels.mask_edges_to_sets(acc)
     return txns, edges, a, incompatible
 

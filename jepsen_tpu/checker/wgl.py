@@ -1316,6 +1316,15 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     results: list[dict | None] = [None] * len(hists)
     encoded = list(enumerate(pre))
     items = []           # (orig index, ops, steps)
+    if encoded and ((_remaining() == 0.0)
+                    or (cancel is not None and cancel())):
+        # budget already gone: report unknown before the per-key scalar
+        # fallback below can dispatch full searches for overflow keys
+        for i, ops in encoded:
+            results[i] = _unknown_result(
+                ops, "batch budget exhausted/cancelled before "
+                "this key's search started", t0)
+        encoded = []
     if encoded:
         if _dense is not False:
             # the bucket's shape, shared group-wide; the group-local
