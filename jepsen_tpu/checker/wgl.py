@@ -750,10 +750,30 @@ def _kernel(model_name: str, F: int, P: int, E: int,
 DENSE_TABLE_CAP = 1 << 22   # max S * 2^P bools held as the dense table
 
 
-@functools.lru_cache(maxsize=32)
 def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
     """Build the jitted dense-table checker for S states x P slots x
-    E entry capacity. Same call shapes as the sort kernel."""
+    E entry capacity. Same call shapes as the sort kernel.
+
+    The Pallas-vs-XLA closure choice is resolved HERE, outside the
+    cache, so flipping JEPSEN_TPU_PALLAS_CLOSURE mid-process takes
+    effect on the next call instead of being baked into a cached
+    kernel."""
+    import jax
+
+    flag = os.environ.get("JEPSEN_TPU_PALLAS_CLOSURE")
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = (flag == "1" or (flag != "0" and on_tpu))
+    return _dense_kernel_cached(model_name, s_lo, S, P, E,
+                                use_pallas, on_tpu)
+
+
+# tests reach through the wrapper to reset compiled state
+_dense_kernel.cache_clear = lambda: _dense_kernel_cached.cache_clear()
+
+
+@functools.lru_cache(maxsize=32)
+def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
+                         E: int, use_pallas: bool, on_tpu: bool):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -769,13 +789,19 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
     COLS = jnp.asarray(cols)
     ARANGE_P = jnp.arange(P)
 
+    # Pallas fused closure round: ON by default on real TPU hardware
+    # (2x on the easy 10k headline, 6x on the adversarial P=14 shape —
+    # the (P, S, C) intermediates never leave VMEM), opt-in elsewhere
+    # (interpret mode keeps it testable on CPU), opt-out via
+    # JEPSEN_TPU_PALLAS_CLOSURE=0 (resolved by the _dense_kernel
+    # wrapper). Shapes past the VMEM gate fall back to the XLA
+    # formulation below.
     pallas_round = None
-    if os.environ.get("JEPSEN_TPU_PALLAS_CLOSURE") == "1":
+    if use_pallas:
         from . import wgl_pallas
         if wgl_pallas.eligible(S, P):
-            # interpret mode off-TPU: the flag stays testable anywhere
             pallas_round = wgl_pallas.closure_round_fn(
-                S, P, interpret=jax.default_backend() != "tpu")
+                S, P, interpret=not on_tpu)
 
     def closure(table, slot_f, slot_a, slot_b, slot_occ):
         """Close the table under linearization of every occupied slot."""
@@ -788,8 +814,9 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
         Mf = M.astype(f32)
 
         if pallas_round is not None:
-            # fused VMEM round (opt-in): transition product + butterfly
-            # + OR-accumulate in one kernel, no HBM intermediates
+            # fused VMEM round (default on TPU): transition product +
+            # butterfly + OR-accumulate in one kernel, no HBM
+            # intermediates
             MfT = jnp.swapaxes(Mf, 1, 2)
 
             def pcond(c):

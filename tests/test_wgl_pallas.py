@@ -53,8 +53,12 @@ def test_eligibility_bounds():
     assert not wgl_pallas.eligible(32, 6)   # C=64: under one lane tile
     assert wgl_pallas.eligible(32, 7)
     assert not wgl_pallas.eligible(30, 7)   # S not sublane-aligned
-    assert not wgl_pallas.eligible(32, 16)  # 32*2^16*4 = 8 MB: too big
-    assert wgl_pallas.eligible(32, 15)      # exactly at the 4 MB cap
+    # VMEM gate (4*S*C + P*S*S floats): hardware-validated boundary —
+    # S=8 P=16 and S=256 P=10 compile, S=8 P=17 and S=512 P=10 blow VMEM
+    assert wgl_pallas.eligible(8, 16)
+    assert not wgl_pallas.eligible(8, 17)
+    assert wgl_pallas.eligible(256, 10)
+    assert not wgl_pallas.eligible(512, 10)
 
 
 def test_dense_engine_end_to_end_with_pallas_round(monkeypatch):
@@ -80,10 +84,12 @@ def test_dense_engine_end_to_end_with_pallas_round(monkeypatch):
         a = analysis_tpu(model, h, engine="dense")
         assert a["analyzer"] == "tpu-wgl-dense"
         assert built, "pallas round was never engaged (eligibility?)"
-        b_env = os.environ.pop("JEPSEN_TPU_PALLAS_CLOSURE")
+        # "0" (not unset): pallas is default-on for TPU backends, so
+        # only an explicit opt-out guarantees run b is the XLA baseline
+        os.environ["JEPSEN_TPU_PALLAS_CLOSURE"] = "0"
         _dense_kernel.cache_clear()
         b = analysis_tpu(model, h, engine="dense")
-        os.environ["JEPSEN_TPU_PALLAS_CLOSURE"] = b_env
+        os.environ["JEPSEN_TPU_PALLAS_CLOSURE"] = "1"
         assert a["valid?"] == b["valid?"]
         assert a.get("op-count") == b.get("op-count")
     finally:
