@@ -48,23 +48,11 @@ MASK_SETS = {
 SET_MASK = {s: m for m, s in MASK_SETS.items()}
 
 
-def edge_accumulator():
-    """(acc, add) for the graph builders' hot path: `add(i, j, bit)`
-    ORs edge-type bits into an {(i, j): mask} dict with no per-edge
-    set allocation.  Convert with `mask_edges_to_sets` at the boundary
-    where consumers expect {(i, j): {'ww', ...}}."""
-    acc: dict[tuple, int] = {}
-    _get = acc.get
-
-    def add(i, j, bit):
-        if i != j:
-            key = (i, j)
-            acc[key] = _get(key, 0) | bit
-
-    return acc, add
-
-
 def mask_edges_to_sets(acc: dict) -> dict:
+    """{(i, j): bitmask} -> {(i, j): frozenset of edge-type names}.
+    The graph builders accumulate edge-type bits inline ({(i, j): mask}
+    with an i != j guard, no per-edge set allocation) and convert here
+    at the boundary where consumers expect {'ww', ...} sets."""
     return {k: MASK_SETS[m] for k, m in acc.items()}
 
 

@@ -133,17 +133,9 @@ def graph(hist):
     a = _Analysis(hist)
     txns = a.oks + a.infos
     idx = {id(o): i for i, o in enumerate(txns)}
-    # bitmask edge accumulation (kernels owns the representation)
-    acc, add = kernels.edge_accumulator()
-
-    # wr: writer -> external readers (exact)
-    for o in a.oks:
-        for k, v in mop.ext_reads(o.get("value") or ()).items():
-            if v is None:
-                continue
-            w = a.writer_of.get((k, v))
-            if w is not None:
-                add(idx[id(w[0])], idx[id(o)], _WR)
+    # bitmask edge accumulation inlined, as in list_append.graph
+    acc: dict[tuple, int] = {}
+    acc_get = acc.get
 
     pairs = a.version_pairs()
     writers_by_key: dict[Any, list] = {}
@@ -158,11 +150,16 @@ def graph(hist):
                 continue
             if u is not _INIT:
                 wu = a.writer_of.get((k, u))
-                if wu is not None:
-                    add(idx[id(wu[0])], idx[id(wv[0])], _WW)
+                if wu is not None and wu[0] is not wv[0]:
+                    key = (idx[id(wu[0])], idx[id(wv[0])])
+                    acc[key] = acc_get(key, 0) | _WW
 
-    # rw: external reader of u -> writers of known successors of u;
-    # a read of nil anti-depends on every writer of that key
+    # wr + rw, one ext_reads pass per op (each read-map is consumed
+    # while hot rather than precomputed into a list — keeping 10k maps
+    # alive simultaneously measurably worsens best-case locality):
+    # wr: writer -> external reader (exact); rw: external reader of u
+    # -> writers of known successors of u, and a read of nil
+    # anti-depends on every writer of that key
     succ: dict[tuple, list] = {}
     for k, ps in pairs.items():
         for u, v in ps:
@@ -171,12 +168,19 @@ def graph(hist):
         for k, v in mop.ext_reads(o.get("value") or ()).items():
             if v is None:
                 for _, w in writers_by_key.get(k, ()):
-                    add(idx[id(o)], idx[id(w)], _RW)
-            else:
-                for v2 in succ.get((k, v), ()):
-                    w2 = a.writer_of.get((k, v2))
-                    if w2 is not None:
-                        add(idx[id(o)], idx[id(w2[0])], _RW)
+                    if w is not o:
+                        key = (idx[id(o)], idx[id(w)])
+                        acc[key] = acc_get(key, 0) | _RW
+                continue
+            w = a.writer_of.get((k, v))
+            if w is not None and w[0] is not o:
+                key = (idx[id(w[0])], idx[id(o)])
+                acc[key] = acc_get(key, 0) | _WR
+            for v2 in succ.get((k, v), ()):
+                w2 = a.writer_of.get((k, v2))
+                if w2 is not None and w2[0] is not o:
+                    key = (idx[id(o)], idx[id(w2[0])])
+                    acc[key] = acc_get(key, 0) | _RW
     edges = kernels.mask_edges_to_sets(acc)
     return txns, edges, a
 
