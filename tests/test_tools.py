@@ -1,0 +1,34 @@
+"""Smoke tests for the repo's measurement tools (tools/*.py): each must
+run standalone on the CPU platform and emit one parseable JSON line —
+the same contract bench.py has with the driver."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    p = subprocess.run([sys.executable, *args], cwd=ROOT, env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    assert p.returncode == 0, p.stderr[-2000:]
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def test_scale_probe_smoke():
+    out = _run(["tools/scale_probe.py", "--n", "1500", "--budget", "120"])
+    assert out["valid"] is True and out["solved_in_budget"] is True
+    assert out["n_ops"] == 1500 and out["ops_per_s"] > 0
+    assert out["analyzer"].startswith("tpu-wgl")
+
+
+def test_profile_elle_smoke():
+    out = _run(["tools/profile_elle.py", "--n", "2000", "--repeat", "2"])
+    assert out["n_txns"] == 2000
+    assert set(out["phases"]) >= {"graph_build_s", "device_scc_closure_s"}
+    assert out["txns_per_s_best"] > 0
