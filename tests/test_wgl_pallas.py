@@ -61,6 +61,34 @@ def test_eligibility_bounds():
     assert not wgl_pallas.eligible(512, 10)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_pallas_dense_agrees_with_host_oracle(monkeypatch, seed):
+    """Randomized golden agreement for the TPU-default path: the dense
+    engine WITH the pallas round must match the host oracle verdict on
+    random histories (valid and corrupted), interpret mode off-TPU."""
+    from jepsen_tpu.checker.linear import analysis_host
+
+    monkeypatch.setenv("JEPSEN_TPU_PALLAS_CLOSURE", "1")
+    _dense_kernel.cache_clear()
+    try:
+        model = models.cas_register()
+        h = synth.register_history(50, concurrency=8, values=5,
+                                   crash_rate=0.08, seed=700 + seed)
+        a = analysis_tpu(model, h, engine="dense")
+        ho = analysis_host(model, h)
+        assert a["analyzer"] == "tpu-wgl-dense"
+        assert a["valid?"] == ho["valid?"], (seed, a, ho)
+        # corrupt() can fabricate out-of-range phantom values that make
+        # the dense table ineligible, so the corrupted run uses 'auto'
+        # (still the pallas round whenever the dense engine engages)
+        bad = synth.corrupt(h, seed=seed)
+        ab = analysis_tpu(model, bad, engine="auto")
+        hb = analysis_host(model, bad)
+        assert ab["valid?"] == hb["valid?"], (seed, ab, hb)
+    finally:
+        _dense_kernel.cache_clear()
+
+
 def test_dense_engine_end_to_end_with_pallas_round(monkeypatch):
     """Env-gated: the dense engine must produce identical verdicts with
     the pallas round (interpret mode off-TPU)."""
