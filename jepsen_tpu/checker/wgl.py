@@ -767,8 +767,14 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
                                 use_pallas, on_tpu)
 
 
-# tests reach through the wrapper to reset compiled state
-_dense_kernel.cache_clear = lambda: _dense_kernel_cached.cache_clear()
+def _clear_dense_caches():
+    """Reset every cache that baked in a dense-kernel build decision
+    (tests reach through the _dense_kernel wrapper for this)."""
+    _dense_kernel_cached.cache_clear()
+    _sharded_runner_cached.cache_clear()
+
+
+_dense_kernel.cache_clear = _clear_dense_caches
 
 
 @functools.lru_cache(maxsize=32)
@@ -1197,9 +1203,11 @@ def _slot_bucket(p: int, p_max: int | None = None) -> int:
     """Bucket a slot count UP to the next even P so nearby keys share
     one compiled kernel, floored at 4 (the smallest dense table worth
     dispatching) and capped at the batch's true max so rounding never
-    exceeds what any key actually needs."""
+    exceeds what any key actually needs. The cap itself respects the
+    floor, so a batch of all-tiny keys still coalesces into one P=4
+    group instead of splitting per exact P."""
     pg = max(4, ((p + 1) // 2) * 2)
-    return min(pg, p_max) if p_max is not None else pg
+    return min(pg, max(p_max, 4)) if p_max is not None else pg
 
 
 def _dense_caps_error(srange, p: int, key=None) -> ValueError:
@@ -1343,15 +1351,6 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     results: list[dict | None] = [None] * len(hists)
     encoded = list(enumerate(pre))
     items = []           # (orig index, ops, steps)
-    if encoded and ((_remaining() == 0.0)
-                    or (cancel is not None and cancel())):
-        # budget already gone: report unknown before the per-key scalar
-        # fallback below can dispatch full searches for overflow keys
-        for i, ops in encoded:
-            results[i] = _unknown_result(
-                ops, "batch budget exhausted/cancelled before "
-                "this key's search started", t0)
-        encoded = []
     if encoded:
         if _dense is not False:
             # the bucket's shape, shared group-wide; the group-local
@@ -1372,10 +1371,20 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
             if dense is None and engine == "dense":
                 # same contract as the scalar path and the multi-key
                 # grouped split: a forced dense engine never silently
-                # degrades to the sort kernel
+                # degrades to the sort kernel. Raised BEFORE the budget
+                # early-exit below so the contract violation surfaces
+                # identically for zero-budget calls.
                 raise _dense_caps_error(srange, max(p_needs.values()))
         if dense is not None:
             slots = dense[2]
+        if ((_remaining() == 0.0) or (cancel is not None and cancel())):
+            # budget already gone: report unknown before the per-key
+            # scalar fallback below can dispatch full searches
+            for i, ops in encoded:
+                results[i] = _unknown_result(
+                    ops, "batch budget exhausted/cancelled before "
+                    "this key's search started", t0)
+            encoded = []
         for i, ops in encoded:
             if dense is None and p_needs[i] > slots:
                 # this key alone exceeds the batch's slot budget:
@@ -1508,24 +1517,34 @@ def _sharded_runner(name, dense, frontier, slots, srange, E, mesh, axis):
     seconds per dispatch and was the bulk of the sharded path's wall
     time. The dense kernel ignores frontier/slots/srange, so they are
     normalized out of the cache key here — spurious misses can't be
-    reintroduced by a call site.
+    reintroduced by a call site. The Pallas-vs-XLA closure choice is
+    resolved here and included in the key, so flipping
+    JEPSEN_TPU_PALLAS_CLOSURE mid-process affects sharded checks the
+    same way it affects scalar/batch ones.
     """
+    import jax
+
+    use_pallas = on_tpu = False
     if dense is not None:
         frontier = slots = srange = None
+        flag = os.environ.get("JEPSEN_TPU_PALLAS_CLOSURE")
+        on_tpu = jax.default_backend() == "tpu"
+        use_pallas = (flag == "1" or (flag != "0" and on_tpu))
     return _sharded_runner_cached(name, dense, frontier, slots, srange,
-                                  E, mesh, axis)
+                                  E, mesh, axis, use_pallas, on_tpu)
 
 
 @functools.lru_cache(maxsize=256)
 def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
-                           mesh, axis):
+                           mesh, axis, use_pallas, on_tpu):
     import jax
     from functools import partial
     from jax.sharding import PartitionSpec as P
 
     if dense is not None:
-        check_batch = _dense_kernel(name, dense[0], dense[1],
-                                    dense[2], E).check_batch
+        check_batch = _dense_kernel_cached(
+            name, dense[0], dense[1], dense[2], E,
+            use_pallas, on_tpu).check_batch
     else:
         check_batch = _kernel(name, frontier, slots, E,
                               _pack_params(srange, slots)).check_batch
