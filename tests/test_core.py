@@ -77,8 +77,16 @@ def test_basic_cas(tmp_path):
         db=testkit.atom_db(state),
         client=testkit.atom_client(state, latency_s=0.0),
         concurrency=10,
+        # The reference's version of this test leaves the first read as
+        # a bare map (core_test.clj:76), which fill-in-op can hand to
+        # the NEMESIS thread (a uniformly random free process) — then
+        # nothing orders the first *client* read before the writers and
+        # the "first read sees 0" assertion races (observed ~1/40 under
+        # CPU load; the reference only runs its copy under the rarely
+        # used :integration tag). Pinning the read to a client keeps
+        # the assertion deterministic without changing what it proves.
         generator=gen.phases(
-            {"f": "read"},
+            gen.clients({"f": "read"}),
             gen.clients(gen.limit(n, gen.reserve(
                 5, gen.repeat({"f": "read"}),
                 gen.mix([
