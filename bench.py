@@ -328,6 +328,28 @@ def section_config3():
         "seconds": round(t3, 2), "txns_per_s": round(10_000 / t3, 1)}}
 
 
+def section_addgraphs():
+    """config3's 10k-txn elle rw-register re-checked with the realtime
+    + process precedence graphs unioned in (checker/elle/graphs.py) —
+    the additional-graphs tax on the perf trajectory.  The history is
+    strict-serializable by construction, so the union graph condenses
+    to trivial SCCs host-side and the section stays meaningful without
+    the chip (anomalous SCCs would take the stacked-level device
+    path)."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.elle import wr
+
+    graphs = ("realtime", "process")
+    h = synth.wr_history(10_000, seed=45100)
+    wr.check(h, additional_graphs=graphs)   # compile / warm caches
+    t, r = _best_of(lambda: wr.check(h, additional_graphs=graphs))
+    assert r["valid?"] is True, \
+        f"addgraphs bench history must verify: {r}"
+    return {"addgraphs_wr_10k": {
+        "seconds": round(t, 2), "txns_per_s": round(10_000 / t, 1),
+        "graphs": list(graphs)}}
+
+
 def section_config4():
     """hazelcast-shape 50k ops sharded over the device mesh."""
     from jepsen_tpu.checker import synth
@@ -458,6 +480,7 @@ SECTIONS = [
     ("config1", section_config1, 420, True),
     ("config2", section_config2, 480, True),
     ("config3", section_config3, 600, True),
+    ("addgraphs", section_addgraphs, 600, True),
     ("config4", section_config4, 900, True),
     ("config5", section_config5, 1200, True),
     ("generator", section_generator, 180, False),
@@ -612,7 +635,7 @@ def main() -> int:
     # sections that stay meaningful without the chip: elle checks on
     # valid histories short-circuit before any device work, and the
     # injected-anomaly leg is forced host-side by JEPSEN_TPU_ELLE_HOST
-    host_capable = {"config3", "config5", "generator"}
+    host_capable = {"config3", "addgraphs", "config5", "generator"}
     if degraded:
         env["JEPSEN_TPU_ELLE_HOST"] = "1"
 
@@ -676,7 +699,7 @@ def main() -> int:
             extra["wgl_engine"] = payload["wgl_engine"]
         elif name == "adversarial":
             extra.update(payload)
-        elif name.startswith("config"):
+        elif name.startswith("config") or name == "addgraphs":
             configs.update(payload)
         elif name == "generator":
             extra.update(payload)

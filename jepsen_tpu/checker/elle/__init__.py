@@ -21,7 +21,7 @@ import dataclasses
 from typing import Iterable
 
 from .. import Checker
-from . import kernels, list_append, wr  # noqa: F401
+from . import graphs, kernels, list_append, wr  # noqa: F401
 
 _EXPANSIONS = {
     "G1": ("G1a", "G1b", "G1c"),
@@ -41,36 +41,48 @@ def expand_anomalies(anomalies: Iterable[str]) -> tuple:
 class ListAppendChecker(Checker):
     """Checker adapter over list_append.check (reference
     `tests/cycle/append.clj:11-55`; default anomalies [:G1 :G2] plus the
-    definite single-pass errors)."""
+    definite single-pass errors). additional_graphs folds realtime /
+    process precedence edges into the cycle search (reference
+    `tests/cycle/append.clj:48-50` via `:additional-graphs`)."""
 
-    def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None):
+    def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None,
+                 additional_graphs=()):
         extra = ("internal", "duplicate-elements", "incompatible-order")
         self.anomalies = expand_anomalies(tuple(anomalies) + extra)
         self.mesh = mesh
+        self.additional_graphs = tuple(additional_graphs)
 
     def check(self, test, hist, opts):
-        return list_append.check(hist, self.anomalies, mesh=self.mesh)
+        return list_append.check(
+            hist, self.anomalies, mesh=self.mesh,
+            additional_graphs=self.additional_graphs)
 
 
 class RWRegisterChecker(Checker):
     """Checker adapter over wr.check (reference
-    `tests/cycle/wr.clj:14-54`)."""
+    `tests/cycle/wr.clj:14-54`; `:additional-graphs` per its lines
+    17-26)."""
 
-    def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None):
+    def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None,
+                 additional_graphs=()):
         extra = ("internal", "duplicate-writes")
         self.anomalies = expand_anomalies(tuple(anomalies) + extra)
         self.mesh = mesh
+        self.additional_graphs = tuple(additional_graphs)
 
     def check(self, test, hist, opts):
-        return wr.check(hist, self.anomalies, mesh=self.mesh)
+        return wr.check(hist, self.anomalies, mesh=self.mesh,
+                        additional_graphs=self.additional_graphs)
 
 
-def list_append_checker(anomalies=("G0", "G1", "G2"), mesh=None) -> Checker:
-    return ListAppendChecker(anomalies, mesh)
+def list_append_checker(anomalies=("G0", "G1", "G2"), mesh=None,
+                        additional_graphs=()) -> Checker:
+    return ListAppendChecker(anomalies, mesh, additional_graphs)
 
 
-def rw_register_checker(anomalies=("G0", "G1", "G2"), mesh=None) -> Checker:
-    return RWRegisterChecker(anomalies, mesh)
+def rw_register_checker(anomalies=("G0", "G1", "G2"), mesh=None,
+                        additional_graphs=()) -> Checker:
+    return RWRegisterChecker(anomalies, mesh, additional_graphs)
 
 
 # ---------------------------------------------------------------------------

@@ -7,11 +7,13 @@ each sub-problem's structure lives:
 
   1. **Sparse condensation (host, linear time).** Every cycle — of any
      edge subset — lies entirely inside one strongly-connected component
-     of the full ww|wr|rw graph (a path between two same-SCC nodes can
-     never leave the SCC). SCC labels are computed in O(V+E) from COO
-     edge lists; a valid history (no nontrivial SCC) short-circuits with
-     zero device work. This is the step that makes 100k-txn histories
-     tractable: the old dense N x N closure needed ~68 GB at that scale.
+     of the full ww|wr|rw graph, unioned with whatever additional
+     precedence graphs (realtime/process, graphs.py) are in play (a path
+     between two same-SCC nodes can never leave the SCC). SCC labels are
+     computed in O(V+E) from COO edge lists; a valid history (no
+     nontrivial SCC) short-circuits with zero device work. This is the
+     step that makes 100k-txn histories tractable: the old dense N x N
+     closure needed ~68 GB at that scale.
   2. **Dense classification (device, MXU).** Nontrivial SCCs are small
      and need *polynomial* closure-type computations to classify the
      Adya anomaly (G0 / G1c / G-single / G2-item) — exactly matmul
@@ -35,15 +37,23 @@ import os
 import numpy as np
 
 _WW, _WR, _RW = 1, 2, 4
+# additional precedence graphs (graphs.py): realtime (completion
+# happened-before invocation) and process (same process, next op).
+# They union into the same adjacency structure as the dependency edges
+# so one SCC condensation covers every cycle of every edge subset.
+_PROC, _RT = 8, 16
+_DEP = _WW | _WR | _RW
 
-# mask <-> {'ww','wr','rw'} tables.  MASK_SETS gives the graph builders
-# shared frozensets (no per-edge allocation); SET_MASK lets
-# analyze_edges recover the mask by hash instead of three membership
+_BIT_NAMES = ((_WW, "ww"), (_WR, "wr"), (_RW, "rw"),
+              (_PROC, "process"), (_RT, "realtime"))
+
+# mask <-> {'ww','wr','rw',...} tables.  MASK_SETS gives the graph
+# builders shared frozensets (no per-edge allocation); SET_MASK lets
+# analyze_edges recover the mask by hash instead of five membership
 # tests.  Frozensets hash by content, so any equal frozenset hits.
 MASK_SETS = {
-    m: frozenset(n for bit, n in ((_WW, "ww"), (_WR, "wr"), (_RW, "rw"))
-                 if m & bit)
-    for m in range(8)
+    m: frozenset(n for bit, n in _BIT_NAMES if m & bit)
+    for m in range(32)
 }
 SET_MASK = {s: m for m, s in MASK_SETS.items()}
 
@@ -66,7 +76,9 @@ def type_mask(types) -> int:
             return m
     return ((_WW if "ww" in types else 0)
             | (_WR if "wr" in types else 0)
-            | (_RW if "rw" in types else 0))
+            | (_RW if "rw" in types else 0)
+            | (_PROC if "process" in types else 0)
+            | (_RT if "realtime" in types else 0))
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -339,9 +351,12 @@ def _classify_oversized(nodes: np.ndarray, src, dst, tmask,
             g2 = True
         if single and g2:
             break
-    if not probed_all and not (g1c or single or g2):
-        # a cycle certainly exists (the SCC is nontrivial); unexplained
-        # by the probes, it needs >= 2 anti-dependencies
+    if not probed_all and not (g1c or single or g2) \
+            and has_subcycle(_WW | _WR | _RW):
+        # a cycle exists on these edges (the union SCC is nontrivial,
+        # but a *folded level* of it may be acyclic — hence the
+        # explicit check); unexplained by the probes, it needs >= 2
+        # anti-dependencies
         g2 = True
     return g0, g1c, single, g2
 
@@ -350,7 +365,31 @@ def _classify_oversized(nodes: np.ndarray, src, dst, tmask,
 # Entry points
 # ---------------------------------------------------------------------------
 
+# Classification runs per *level*: the base level sees only dependency
+# edges; each additional level folds its precedence edges into the ww
+# matrix (a precedence edge behaves exactly like a write-write order for
+# cycle purposes) and re-runs the SAME classifier — so the device kernel
+# and its host mirror stay byte-identical, and level batches stack along
+# the vmapped batch axis.  A variant anomaly (e.g. G-single-realtime) is
+# reported only for SCCs where the level's flag holds and the previous
+# level's does not — a cycle that *requires* the extra edge type.
+# Realtime subsumes process (a process issues its next op only after the
+# previous completed), hence the realtime level folds both.
+_VARIANT_BASES = ("G0", "G1c", "G-single", "G2-item")
+_LEVEL_SPECS = (("-process", _PROC), ("-realtime", _PROC | _RT))
+
 _EMPTY = {"G0": False, "G1c": False, "G-single": False, "G2-item": False}
+_EMPTY.update({f"{b}{s}": False
+               for s, _m in _LEVEL_SPECS for b in _VARIANT_BASES})
+
+
+def _fold_level(src, dst, tmask, extra: int):
+    """Project a union-graph edge set onto one classification level:
+    keep dependency bits, fold the level's precedence bits into ww, drop
+    edges that carry neither."""
+    t = (tmask & _DEP) | np.where(tmask & extra, _WW, 0).astype(tmask.dtype)
+    keep = t != 0
+    return src[keep], dst[keep], t[keep]
 
 
 def analyze_edges(n: int, edges: dict, mesh=None,
@@ -382,6 +421,12 @@ def analyze_edges(n: int, edges: dict, mesh=None,
                 out["G1c"] = True
             if "rw" in types:
                 out["G-single"] = True
+            if not (types & {"ww", "wr", "rw"}):
+                # a pure precedence self-loop: an op before itself
+                if "process" in types:
+                    out["G0-process"] = True
+                elif "realtime" in types:
+                    out["G0-realtime"] = True
     plain = {(i, j): t for (i, j), t in edges.items() if i != j}
     if not plain:
         out["cycle-nodes"] = np.asarray(sorted(set(self_nodes)), np.int64)
@@ -426,8 +471,49 @@ def analyze_edges(n: int, edges: dict, mesh=None,
     e_src, e_dst, e_t = src[esel], dst[esel], tmask[esel]
     e_lab = labels[e_src]
 
+    # classification levels: base always; an additional level per
+    # precedence graph present in some nontrivial SCC (gated on the
+    # intra-SCC edges, not the whole graph — realtime edges connect
+    # nearly every non-concurrent op pair, but only the ones inside an
+    # SCC can participate in a cycle, so levels without any such edge
+    # would just replicate the base level's device work)
+    levels = [("", 0)]
+    for suffix, extra in _LEVEL_SPECS:
+        new_bits = extra & ~(_DEP | levels[-1][1])
+        if bool((e_t & new_bits).any()):
+            levels.append((suffix, extra))
+    n_levels = len(levels)
+
+    # per-SCC G2 probes, memoized by (label, level): the dense
+    # distinct-rw-sources test over-approximates, so each flagged SCC is
+    # host-verified with the stricter simple-path probe
+    _g2_cache: dict[tuple, bool] = {}
+
+    def g2_verified(lab: int, li: int) -> bool:
+        key = (lab, li)
+        got = _g2_cache.get(key)
+        if got is None:
+            emask = e_lab == lab
+            got = _probe_g2(*_fold_level(
+                e_src[emask], e_dst[emask], e_t[emask], levels[li][1]))
+            _g2_cache[key] = got
+        return got
+
+    def combine(per_level: list) -> None:
+        """OR one SCC's per-level (g0, g1c, single, g2) flags into out.
+        Base level reports directly; each later level reports only what
+        the previous level could not explain — cycles that *require*
+        that level's precedence edges."""
+        for li, (suffix, _x) in enumerate(levels):
+            f = per_level[li]
+            if li:
+                f = tuple(a and not b
+                          for a, b in zip(f, per_level[li - 1]))
+            for base, v in zip(_VARIANT_BASES, f):
+                if v:
+                    out[base + suffix] = True
+
     # group SCCs into power-of-two buckets; oversized ones go host-side
-    g0 = g1c = single = g2 = False
     by_bucket: dict[int, list] = {}
     for lab in nontrivial.tolist():
         size = int(sizes[lab])
@@ -435,10 +521,9 @@ def analyze_edges(n: int, edges: dict, mesh=None,
             out["oversized-sccs"] += 1
             nodes = np.flatnonzero(labels == lab)
             emask = e_lab == lab
-            f0, f1, fs, f2 = _classify_oversized(
-                nodes, e_src[emask], e_dst[emask], e_t[emask])
-            g0, g1c = g0 or f0, g1c or f1
-            single, g2 = single or fs, g2 or f2
+            combine([_classify_oversized(nodes, *_fold_level(
+                e_src[emask], e_dst[emask], e_t[emask], extra))
+                for _suffix, extra in levels])
         else:
             by_bucket.setdefault(_bucket(size), []).append(lab)
 
@@ -448,6 +533,7 @@ def analyze_edges(n: int, edges: dict, mesh=None,
         ww = np.zeros((b, e, e), np.float32)
         wr = np.zeros((b, e, e), np.float32)
         rw = np.zeros((b, e, e), np.float32)
+        aux = [np.zeros((b, e, e), np.float32) for _ in levels[1:]]
         slot = {lab: ix for ix, lab in enumerate(labs)}
         mask = np.isin(e_lab, labs)
         for i, j, t, lab in zip(e_src[mask], e_dst[mask], e_t[mask],
@@ -460,27 +546,28 @@ def analyze_edges(n: int, edges: dict, mesh=None,
                 wr[s, r, c] = 1.0
             if t & _RW:
                 rw[s, r, c] = 1.0
-        buckets[e] = (ww, wr, rw)
+            for lx, (_suffix, extra) in enumerate(levels[1:]):
+                if t & extra:
+                    aux[lx][s, r, c] = 1.0
+        # levels stack along the batch axis (same kernel, one launch):
+        # level li's ww block is ww with its precedence edges folded in
+        buckets[e] = (
+            np.concatenate([ww] + [np.maximum(ww, a) for a in aux]),
+            np.concatenate([wr] * n_levels),
+            np.concatenate([rw] * n_levels))
     if buckets:
         flags = _classify_batches(buckets, mesh=mesh)
         for e, (f0, f1, fs, f2) in flags.items():
-            g0 = g0 or bool(f0.any())
-            g1c = g1c or bool(f1.any())
-            single = single or bool(fs.any())
-            # the dense distinct-rw-sources G2 test can be fooled by two
-            # one-rw cycles sharing a node: host-verify each flagged SCC
-            # with the stricter probe before believing it
-            for ix in np.flatnonzero(f2):
-                if g2:
-                    break
-                lab = by_bucket[e][int(ix)]
-                emask = e_lab == lab
-                g2 = _probe_g2(e_src[emask], e_dst[emask], e_t[emask])
-
-    out["G0"] = out["G0"] or g0
-    out["G1c"] = out["G1c"] or g1c
-    out["G-single"] = out["G-single"] or single
-    out["G2-item"] = out["G2-item"] or g2
+            labs = by_bucket[e]
+            b = len(labs)
+            for ix, lab in enumerate(labs):
+                per_level = []
+                for li in range(n_levels):
+                    o = li * b + ix
+                    per_level.append((
+                        bool(f0[o]), bool(f1[o]), bool(fs[o]),
+                        bool(f2[o]) and g2_verified(lab, li)))
+                combine(per_level)
     return out
 
 
@@ -591,7 +678,8 @@ def find_path(edges: dict, src: int, dst: int, allowed: set) -> list | None:
 
 def _find_g2_path(edges: dict, src: int, dst: int,
                   exclude_src: int | None = None,
-                  step_budget: int = 200_000) -> list | None:
+                  step_budget: int = 200_000,
+                  allowed: set | None = None) -> list | None:
     """A *simple* src -> dst path over all edges that traverses at
     least one rw edge — closing a G2 cycle with the rw edge
     (exclude_src -> src), whose own rw must not be double-counted
@@ -605,9 +693,15 @@ def _find_g2_path(edges: dict, src: int, dst: int,
     guards it; on exhaustion we fall back to the polynomial
     state-BFS over (node, rw-used?) — an over-approximation that can
     mislabel a figure-eight as G2, conservative toward reporting the
-    (definitely present) cyclic anomaly."""
+    (definitely present) cyclic anomaly.
+
+    `allowed` restricts the traversable edge types (None = all); the
+    certificate layer passes it so a base-level G2 search never walks
+    the precedence (process/realtime) edges of a union graph."""
     adj: dict[int, list] = {}
     for (i, j), types in edges.items():
+        if allowed is not None and not (types & allowed):
+            continue
         counts = "rw" in types and i != exclude_src
         adj.setdefault(i, []).append((j, counts))
 
@@ -651,16 +745,51 @@ def _g2_walk_fallback(adj: dict, src: int, dst: int) -> list | None:
     return None
 
 
+def _find_path_requiring(edges: dict, src: int, dst: int,
+                         allowed: set, required: str) -> list | None:
+    """Shortest src -> dst walk over `allowed`-typed edges that uses at
+    least one edge of type `required` — state-BFS over (node, used?).
+    Certificate-quality: a node may appear twice (once per state)."""
+    from collections import deque
+
+    adj: dict[int, list] = {}
+    for (i, j), types in edges.items():
+        if types & allowed:
+            adj.setdefault(i, []).append((j, required in types))
+    q = deque([(src, False, [src])])
+    seen = {(src, False)}
+    while q:
+        node, used, path = q.popleft()
+        for nxt, is_req in adj.get(node, ()):
+            u = used or is_req
+            if nxt == dst:
+                if u:
+                    return path + [nxt]
+                continue
+            if (nxt, u) not in seen:
+                seen.add((nxt, u))
+                q.append((nxt, u, path + [nxt]))
+    return None
+
+
+# variant certificate searches: which precedence types the cycle may
+# traverse, and which one its existence proves it needs
+_VARIANT_CERT = (("-process", {"process"}, "process"),
+                 ("-realtime", {"process", "realtime"}, "realtime"))
+
+
 def certificates(txns: list, edges: dict, cyc: dict,
                  brief=None) -> dict:
     """Host-side certificates for whichever cycle anomalies the device
     reported. Each certificate is a node cycle (first == last) whose edge
     types actually exhibit the claimed anomaly: G0 uses only ww, G1c only
-    ww/wr, G-single exactly one rw, G2-item at least two rw.
+    ww/wr, G-single exactly one rw, G2-item at least two rw; the
+    -process/-realtime variants additionally traverse (and, where the
+    search can enforce it, require) a precedence edge of that type.
 
-    Candidate start nodes / rw edges are restricted to nontrivial SCCs
-    ('cycle-nodes' / 'scc-labels' from analyze_edges), since every cycle
-    lives inside one."""
+    Candidate start nodes / typed edges are restricted to nontrivial
+    SCCs ('cycle-nodes' / 'scc-labels' from analyze_edges), since every
+    cycle lives inside one."""
     if brief is None:
         brief = _brief_op
     out: dict = {}
@@ -669,9 +798,13 @@ def certificates(txns: list, edges: dict, cyc: dict,
         on_cycle = np.flatnonzero(np.diag(cyc["closure"]))
     labels = cyc.get("scc-labels")
     cyc_set = set(int(i) for i in on_cycle)
-    rw_edges = [(i, j) for (i, j), types in edges.items()
-                if "rw" in types and i in cyc_set and j in cyc_set
+
+    def typed_edges(t):
+        return [(i, j) for (i, j), types in edges.items()
+                if t in types and i in cyc_set and j in cyc_set
                 and (labels is None or labels[i] == labels[j])]
+
+    rw_edges = typed_edges("rw")
 
     def emit(typ, cert):
         out[typ] = [{"cycle": [brief(txns[i]) for i in cert]
@@ -696,11 +829,57 @@ def certificates(txns: list, edges: dict, cyc: dict,
     if cyc["G2-item"]:
         cert = None
         for i, j in rw_edges:
-            back = _find_g2_path(edges, j, i, exclude_src=i)
+            back = _find_g2_path(edges, j, i, exclude_src=i,
+                                 allowed={"ww", "wr", "rw"})
             if back is not None:
                 cert = [i] + back
                 break
         emit("G2-item", cert)
+
+    for suffix, extra, req in _VARIANT_CERT:
+        req_edges = None  # computed lazily, only when a variant fired
+        for typ, allowed in (("G0", {"ww"}), ("G1c", {"ww", "wr"})):
+            if not cyc.get(typ + suffix):
+                continue
+            if req_edges is None:
+                req_edges = typed_edges(req)
+            cert = None
+            for i, j in req_edges:
+                back = find_path(edges, j, i, allowed | extra)
+                if back is not None:
+                    cert = [i] + back  # i -req-> j =allowed=> i
+                    break
+            emit(typ + suffix, cert)
+        if cyc.get("G-single" + suffix):
+            cert = None
+            for i, j in rw_edges:
+                if req in edges.get((i, j), ()):
+                    # the anti-dependency edge itself carries the
+                    # precedence type; any ww/wr return path closes it
+                    back = find_path(edges, j, i, {"ww", "wr"} | extra)
+                else:
+                    back = _find_path_requiring(
+                        edges, j, i, {"ww", "wr"} | extra, req)
+                if back is not None:
+                    cert = [i] + back
+                    break
+            emit("G-single" + suffix, cert)
+        if cyc.get("G2-item" + suffix):
+            cert = fallback = None
+            for i, j in rw_edges:
+                back = _find_g2_path(
+                    edges, j, i, exclude_src=i,
+                    allowed={"ww", "wr", "rw"} | extra)
+                if back is None:
+                    continue
+                nodes = [i] + back
+                if fallback is None:
+                    fallback = nodes
+                if any(req in edges.get((u, v), ())
+                       for u, v in zip(nodes, nodes[1:])):
+                    cert = nodes
+                    break
+            emit("G2-item" + suffix, cert or fallback)
     return out
 
 

@@ -33,7 +33,7 @@ from typing import Any
 
 from ... import txn as mop
 from ...history import history as as_history, is_fail, is_info, is_ok
-from . import kernels
+from . import graphs as precedence, kernels
 
 
 def _is_append(m) -> bool:
@@ -278,12 +278,22 @@ DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
                      "incompatible-order")
 
 
-def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
+def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None,
+          additional_graphs=()) -> dict:
     """Full list-append analysis. Returns {'valid?': ..,
     'anomaly-types': [..], 'anomalies': {type: [case...]}}, matching the
-    reference checker's result shape (`tests/cycle/append.clj:28-55`)."""
+    reference checker's result shape (`tests/cycle/append.clj:28-55`).
+    additional_graphs names extra precedence graphs
+    ('realtime'/'process') to union into the cycle search, enabling the
+    -realtime/-process anomaly variants."""
     hist = as_history(hist).index()
     txns, edges, a, incompatible = graph(hist)
+    if additional_graphs:
+        edges = precedence.union_edges(
+            edges, precedence.additional_edges(a.hist, txns,
+                                               additional_graphs))
+        anomalies = precedence.expand_anomalies(anomalies,
+                                                additional_graphs)
     found: dict[str, list] = {}
 
     if a.duplicates:

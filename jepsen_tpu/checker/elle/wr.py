@@ -28,7 +28,7 @@ from typing import Any
 
 from ... import txn as mop
 from ...history import history as as_history, is_fail, is_info, is_ok
-from . import kernels
+from . import graphs as precedence, kernels
 
 _WW, _WR, _RW = kernels._WW, kernels._WR, kernels._RW
 
@@ -70,7 +70,10 @@ class _Analysis:
         for o in self.oks + self.infos:
             writes: dict[Any, list] = {}
             for m in o.get("value") or ():
-                if m[0] == "w":
+                # a None-valued write is unresolved (e.g. a crashed
+                # read-increment whose value was never filled in): it
+                # identifies no version, so it carries no information
+                if m[0] == "w" and m[2] is not None:
                     writes.setdefault(m[1], []).append(m[2])
             for k, vs in writes.items():
                 for i, v in enumerate(vs):
@@ -83,7 +86,7 @@ class _Analysis:
             (mop.key(m), mop.value(m)): o
             for o in self.fails
             for m in (o.get("value") or ())
-            if mop.is_write(m)}
+            if mop.is_write(m) and mop.value(m) is not None}
 
     def version_pairs(self):
         """Known per-key order pairs {k: set of (u, v)} with u possibly
@@ -95,7 +98,7 @@ class _Analysis:
                 k, v = m[1], m[2]
                 if m[0] == "r":
                     cur[k] = _INIT if v is None else v
-                else:
+                elif v is not None:
                     u = cur.get(k)
                     if u is not None and u != v:
                         pairs.setdefault(k, set()).add((u, v))
@@ -189,11 +192,20 @@ DEFAULT_ANOMALIES = ("G0", "G1a", "G1b", "G1c", "G-single", "G2-item",
                      "internal", "duplicate-writes")
 
 
-def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None) -> dict:
+def check(hist, anomalies=DEFAULT_ANOMALIES, mesh=None,
+          additional_graphs=()) -> dict:
     """Full rw-register analysis; result shape mirrors the reference
-    checker (`tests/cycle/wr.clj:46-54`)."""
+    checker (`tests/cycle/wr.clj:46-54`). additional_graphs names extra
+    precedence graphs ('realtime'/'process') to union into the cycle
+    search, enabling the -realtime/-process anomaly variants."""
     hist = as_history(hist).index()
     txns, edges, a = graph(hist)
+    if additional_graphs:
+        edges = precedence.union_edges(
+            edges, precedence.additional_edges(a.hist, txns,
+                                               additional_graphs))
+        anomalies = precedence.expand_anomalies(anomalies,
+                                                additional_graphs)
     found: dict[str, list] = {}
     if a.duplicates:
         found["duplicate-writes"] = a.duplicates

@@ -8,7 +8,10 @@ retry/abort classification — SQLSTATE 40001 serialization conflicts are
 definite aborts (`client.clj:150-210`) — and the workload menu:
 bank (`bank.clj`), elle rw-register (BASELINE config 3 at 10k txns),
 independent linearizable register (`register.clj`), grow-only set
-(`sets.clj`), and the Adya G2 predicate probe (`adya.clj`).
+(`sets.clj`), the Adya G2 predicate probe (`adya.clj`), and the
+additional-graphs consumers: monotonic (`monotonic.clj`), sequential
+(`sequential.clj`), and the realtime-gap comments probe
+(`comments.clj`).
 
 The clock-skew nemesis family (`nemesis.clj:201-270`, driving the
 suite-local bumptime/adjtime C tools) maps to the framework clock
@@ -31,7 +34,8 @@ from .. import generator as gen
 from .. import independent
 from ..control import util as cu
 from ..workloads import adya as adya_w, bank as bank_w, \
-    linearizable_register, wr as wr_w
+    comments as comments_w, linearizable_register, \
+    monotonic as monotonic_w, sequential as sequential_w, wr as wr_w
 from . import std_opts, std_test
 from .pg_proto import Conn, PGError
 
@@ -267,6 +271,69 @@ class WrTxnClient(_SQLClient):
                          read_only=all(m[0] == "r" for m in txn))
 
 
+# -- monotonic (`monotonic.clj`) ---------------------------------------------
+
+class MonotonicClient(_SQLClient):
+    """Read-increment-write registers (`monotonic.clj:33-88`): a 'w'
+    micro-op with a nil value writes its key's just-read value + 1;
+    CockroachDB's serializable default makes the read-modify-write
+    atomic without explicit locks."""
+
+    def setup(self, test):
+        self.conn.query("create table if not exists mono "
+                        "(id int primary key, val int)")
+
+    def invoke(self, test, op):
+        txn = op["value"]
+
+        def body(conn):
+            out = []
+            cur: dict = {}
+            for m in txn:
+                f, k, v = m[0], m[1], m[2]
+                if f == "r":
+                    rows, _ = conn.query(
+                        f"select val from mono where id = {_q(k)}")
+                    val = None if not rows or rows[0][0] is None \
+                        else int(rows[0][0])
+                    cur[k] = val
+                    out.append(["r", k, val])
+                else:
+                    val = v if v is not None else (cur.get(k) or 0) + 1
+                    conn.query(f"upsert into mono (id, val) values "
+                               f"({_q(k)}, {_q(val)})")
+                    cur[k] = val
+                    out.append(["w", k, val])
+            return {"value": out}
+
+        return self._txn(body, op,
+                         read_only=all(m[0] == "r" for m in txn))
+
+
+# -- comments (`comments.clj`) -----------------------------------------------
+
+class CommentsClient(_SQLClient):
+    """Insert numbered rows, read all of them back (`comments.clj:
+    20-63` — the suite's realtime-gap probe)."""
+
+    def setup(self, test):
+        self.conn.query("create table if not exists comments "
+                        "(id int primary key, val int)")
+
+    def invoke(self, test, op):
+        if op["f"] == "write":
+            def write_body(conn):
+                conn.query(f"insert into comments (id, val) values "
+                           f"({_q(op['value'])}, 1)")
+                return {}
+            return self._txn(write_body, op)
+
+        def read_body(conn):
+            rows, _ = conn.query("select id from comments")
+            return {"value": sorted(int(r[0]) for r in rows)}
+        return self._txn(read_body, op, read_only=True)
+
+
 # -- linearizable register (`register.clj`) ----------------------------------
 
 class RegisterClient(_SQLClient):
@@ -430,12 +497,33 @@ def g2_workload(opts: dict) -> dict:
     return w
 
 
+def monotonic_workload(opts: dict) -> dict:
+    w = monotonic_w.workload(opts)
+    w["client"] = MonotonicClient()
+    return w
+
+
+def sequential_workload(opts: dict) -> dict:
+    w = sequential_w.workload(opts)
+    w["client"] = WrTxnClient()
+    return w
+
+
+def comments_workload(opts: dict) -> dict:
+    w = comments_w.workload(opts)
+    w["client"] = CommentsClient()
+    return w
+
+
 WORKLOADS = {
     "bank": bank_workload,
     "wr": wr_workload,
     "register": register_workload,
     "set": set_workload,
     "g2": g2_workload,
+    "monotonic": monotonic_workload,
+    "sequential": sequential_workload,
+    "comments": comments_workload,
 }
 
 

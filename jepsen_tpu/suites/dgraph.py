@@ -1013,10 +1013,13 @@ def wr_workload(opts: dict) -> dict:
     """Elle rw-register over the generic txn client. Dgraph offers
     snapshot isolation, so G2-item (write skew) is permitted — the
     anomaly set is the reference's `[:G0 :G1c :G-single :G1a :G1b
-    :internal]` (`wr.clj:22-26`), i.e. everything up to SI."""
+    :internal]` (`wr.clj:22-26`), i.e. everything up to SI — with the
+    realtime precedence graph unioned into the cycle search
+    (`wr.clj:26` `:additional-graphs [cycle/realtime-graph]`)."""
     w = wrw.workload({"anomalies": ("G0", "G1", "G-single"),
                       "key-count": 4, "min-txn-length": 2,
-                      "max-txn-length": 4, "max-writes-per-key": 16})
+                      "max-txn-length": 4, "max-writes-per-key": 16,
+                      "additional-graphs": ("realtime",)})
     return {**w, "client": TxnClient()}
 
 

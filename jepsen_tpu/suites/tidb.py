@@ -6,8 +6,10 @@ with the reference's error classification and retry semantics
 (`sql.clj`), and the workload menu that matters for the north-star
 configs — elle list-append (`txn.clj`, BASELINE config 5 at 100k txns),
 rw-register, bank (`bank.clj`), independent linearizable register
-(`register.clj`), grow-only set (`sets.clj`), and long-fork
-(`long_fork.clj`).
+(`register.clj`), grow-only set (`sets.clj`), long-fork
+(`long_fork.clj`), and the additional-graphs consumers: monotonic
+(`monotonic.clj`), sequential (`sequential.clj`), and table
+(`table.clj`).
 
 Clients speak the wire protocol directly (`mysql_proto.py`) — no driver
 dependency; hermetic tests run against an in-process MySQL-protocol
@@ -25,7 +27,9 @@ from .. import generator as gen
 from .. import independent
 from ..control import util as cu
 from ..workloads import append as append_w, bank as bank_w, \
-    linearizable_register, long_fork as long_fork_w, wr as wr_w
+    linearizable_register, long_fork as long_fork_w, \
+    monotonic as monotonic_w, sequential as sequential_w, \
+    table as table_w, wr as wr_w
 from . import std_opts, std_test
 from .mysql_proto import Conn, MySQLError
 
@@ -302,6 +306,80 @@ class WrTxnClient(TxnClient):
         return super()._mop(conn, m)
 
 
+# -- monotonic (`monotonic.clj`) ---------------------------------------------
+
+class MonotonicClient(_SQLClient):
+    """Read-increment-write registers (`monotonic.clj:24-60`): a 'w'
+    micro-op with a nil value writes its key's just-read value + 1, so
+    every committed write is predecessor + 1. Reads in read-write txns
+    take locks (select for update) like the reference's increments."""
+
+    def setup(self, test):
+        self.conn.query("create table if not exists mono "
+                        "(id int not null primary key, val int)")
+
+    def invoke(self, test, op):
+        txn = op["value"]
+        read_only = all(m[0] == "r" for m in txn)
+
+        def body(conn):
+            out = []
+            cur: dict = {}
+            for m in txn:
+                f, k, v = m[0], m[1], m[2]
+                if f == "r":
+                    lock = "" if read_only else " for update"
+                    rows, _ = conn.query(
+                        f"select val from mono where id = {_q(k)}"
+                        f"{lock}")
+                    val = None if not rows or rows[0][0] is None \
+                        else int(rows[0][0])
+                    cur[k] = val
+                    out.append(["r", k, val])
+                else:
+                    val = v if v is not None else (cur.get(k) or 0) + 1
+                    conn.query(
+                        f"insert into mono (id, val) values "
+                        f"({_q(k)}, {_q(val)}) "
+                        f"on duplicate key update val = {_q(val)}")
+                    cur[k] = val
+                    out.append(["w", k, val])
+            return {"value": out}
+
+        return self._txn(body, op, read_only=read_only)
+
+
+# -- table (`table.clj`) -----------------------------------------------------
+
+class TableClient(_SQLClient):
+    """Creates numbered tables and races inserts into them; an insert
+    that finds no table fails ['table-missing', t] (MySQL 1146), which
+    the checker cross-references against create completions."""
+
+    def invoke(self, test, op):
+        if op["f"] == "create-table":
+            t = op["value"]
+            try:
+                self.conn.query(
+                    f"create table if not exists tbl{_q(t)} "
+                    f"(id int not null primary key, val int)")
+                return {**op, "type": "ok"}
+            except Exception as e:  # noqa: BLE001 — classified
+                return self._capture(op, e, read_only=False)
+        t, k = op["value"]
+        try:
+            self.conn.query(f"insert into tbl{_q(t)} (id, val) values "
+                            f"({_q(k)}, 1)")
+            return {**op, "type": "ok"}
+        except MySQLError as e:
+            if e.code == 1146:
+                return {**op, "type": "fail",
+                        "error": ["table-missing", t]}
+            return self._capture(op, e, read_only=False)
+        except Exception as e:  # noqa: BLE001 — classified
+            return self._capture(op, e, read_only=False)
+
+
 # -- bank (`bank.clj`) -------------------------------------------------------
 
 class BankClient(_SQLClient):
@@ -497,6 +575,24 @@ def long_fork_workload(opts: dict) -> dict:
     return w
 
 
+def monotonic_workload(opts: dict) -> dict:
+    w = monotonic_w.workload(opts)
+    w["client"] = MonotonicClient()
+    return w
+
+
+def sequential_workload(opts: dict) -> dict:
+    w = sequential_w.workload(opts)
+    w["client"] = WrTxnClient()
+    return w
+
+
+def table_workload(opts: dict) -> dict:
+    w = table_w.workload(opts)
+    w["client"] = TableClient()
+    return w
+
+
 WORKLOADS = {
     "append": append_workload,
     "wr": wr_workload,
@@ -504,6 +600,9 @@ WORKLOADS = {
     "register": register_workload,
     "set": set_workload,
     "long-fork": long_fork_workload,
+    "monotonic": monotonic_workload,
+    "sequential": sequential_workload,
+    "table": table_workload,
 }
 
 

@@ -1232,9 +1232,12 @@ def multi_key_acid_workload(opts, client) -> dict:
 
 
 def append_workload(opts, client) -> dict:
-    """Elle list-append (`append.clj:12-19`)."""
+    """Elle list-append (`append.clj:12-19`); YugaByte claims
+    serializability, so the realtime precedence graph joins the cycle
+    search (`append.clj:17` `:additional-graphs [cycle/realtime-graph]`)."""
     w = append_w.workload({"key-count": 32, "max-txn-length": 4,
-                           "max-writes-per-key": 1024})
+                           "max-writes-per-key": 1024,
+                           "additional-graphs": ("realtime",)})
     return {"client": client, "generator": w["generator"],
             "checker": w["checker"]}
 

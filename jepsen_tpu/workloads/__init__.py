@@ -7,8 +7,10 @@ with at least 'generator' and 'checker' entries, merged into a test map by
 suites (pattern: `zookeeper.clj:106-129`).
 """
 
-from . import adya, append, bank, causal, causal_reverse, \
-    linearizable_register, long_fork, wr  # noqa: F401
+from . import adya, append, bank, causal, causal_reverse, comments, \
+    linearizable_register, long_fork, monotonic, sequential, table, \
+    wr  # noqa: F401
 
 __all__ = ["adya", "append", "bank", "causal", "causal_reverse",
-           "linearizable_register", "long_fork", "wr"]
+           "comments", "linearizable_register", "long_fork", "monotonic",
+           "sequential", "table", "wr"]

@@ -11,12 +11,14 @@ from ..checker import elle
 def workload(opts: dict | None = None) -> dict:
     """Options: 'key-count', 'min-txn-length', 'max-txn-length',
     'max-writes-per-key', 'anomalies' (default ['G1', 'G2'], matching
-    `append.clj:34-40`), 'consistency-models' alias accepted."""
+    `append.clj:34-40`), 'additional-graphs' (e.g. ('realtime',) per
+    `append.clj:48-50`), 'consistency-models' alias accepted."""
     opts = opts or {}
     anomalies = tuple(opts.get("anomalies", ("G1", "G2")))
     return {
-        "checker": elle.list_append_checker(anomalies,
-                                            mesh=opts.get("mesh")),
+        "checker": elle.list_append_checker(
+            anomalies, mesh=opts.get("mesh"),
+            additional_graphs=tuple(opts.get("additional-graphs", ()))),
         "generator": elle.append_gen(
             key_count=opts.get("key-count", 5),
             min_txn_length=opts.get("min-txn-length", 1),

@@ -12,8 +12,9 @@ def workload(opts: dict | None = None) -> dict:
     opts = opts or {}
     anomalies = tuple(opts.get("anomalies", ("G1", "G2")))
     return {
-        "checker": elle.rw_register_checker(anomalies,
-                                            mesh=opts.get("mesh")),
+        "checker": elle.rw_register_checker(
+            anomalies, mesh=opts.get("mesh"),
+            additional_graphs=tuple(opts.get("additional-graphs", ()))),
         "generator": elle.wr_gen(
             key_count=opts.get("key-count", 5),
             min_txn_length=opts.get("min-txn-length", 1),

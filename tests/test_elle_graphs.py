@@ -1,0 +1,352 @@
+"""Additional-graphs (realtime / process) cycle-search tests: the
+reference folds extra precedence graphs into Elle's cycle checkers
+(`tests/cycle.clj:9-16`, `tests/cycle/wr.clj:17-26`); these fixtures
+port that surface — including a cycle visible only through the realtime
+edge and one only through the process edge — and pin host/device
+agreement on every one."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker.elle import graphs, kernels, list_append, wr
+from jepsen_tpu.history import history
+
+
+def _ok(process, txn, t):
+    return [{"type": "invoke", "f": "txn", "value": txn,
+             "process": process, "time": t},
+            {"type": "ok", "f": "txn", "value": txn, "process": process,
+             "time": t + 1}]
+
+
+def _info(process, txn, t):
+    return [{"type": "invoke", "f": "txn", "value": txn,
+             "process": process, "time": t},
+            {"type": "info", "f": "txn", "value": txn,
+             "process": process, "time": t + 1}]
+
+
+# -- graph builders ---------------------------------------------------------
+
+def test_realtime_edges_frontier_reduction():
+    # T0 completes before T1 and T2 invoke; T1 completes before T2
+    # invokes. The T0 -> T2 pair is implied via T0 -> T1 -> T2, so the
+    # reduced edge set must not materialize it.
+    h = history(_ok(0, [["w", "x", 1]], 0)
+                + _ok(1, [["w", "x", 2]], 2)
+                + _ok(2, [["w", "x", 3]], 4)).index()
+    txns = [o for o in h if o["type"] == "ok"]
+    edges = graphs.realtime_edges(h, txns)
+    assert set(edges) == {(0, 1), (1, 2)}
+
+
+def test_realtime_edges_concurrent_ops_unordered():
+    # overlapping ops: invoke A, invoke B, ok A, ok B — no edges
+    h = history([
+        {"type": "invoke", "f": "txn", "value": [], "process": 0,
+         "time": 0},
+        {"type": "invoke", "f": "txn", "value": [], "process": 1,
+         "time": 1},
+        {"type": "ok", "f": "txn", "value": [], "process": 0, "time": 2},
+        {"type": "ok", "f": "txn", "value": [], "process": 1, "time": 3},
+    ]).index()
+    txns = [o for o in h if o["type"] == "ok"]
+    assert graphs.realtime_edges(h, txns) == {}
+
+
+def test_info_ops_take_only_incoming_realtime_edges():
+    h = history(_ok(0, [["w", "x", 1]], 0)
+                + _info(1, [["w", "x", 2]], 2)
+                + _ok(2, [["w", "x", 3]], 4)).index()
+    txns = ([o for o in h if o["type"] == "ok"]
+            + [o for o in h if o["type"] == "info"])
+    edges = graphs.realtime_edges(h, txns)
+    # ok(0) precedes both later ops; the info node (index 2 in txns)
+    # never completes, so nothing follows it
+    assert (0, 2) in edges and (0, 1) in edges
+    assert not any(i == 2 for (i, _j) in edges)
+
+
+def test_process_edges_chain_and_info_break():
+    h = history(_ok(0, [["w", "x", 1]], 0)
+                + _ok(1, [["w", "y", 1]], 1)
+                + _info(0, [["w", "x", 2]], 2)).index()
+    txns = ([o for o in h if o["type"] == "ok"]
+            + [o for o in h if o["type"] == "info"])
+    edges = graphs.process_edges(h, txns)
+    # process 0: ok -> info chain edge; the info op ends the chain
+    assert edges == {(0, 2): kernels._PROC}
+
+
+def test_completion_only_history_gains_no_realtime_edges():
+    # completion-only journals are legal checker input; without
+    # invocations nothing proves any op began after another completed,
+    # so the realtime graph must stay empty (edges would fabricate
+    # anomalies for genuinely concurrent ops)
+    h = history([
+        {"type": "ok", "f": "txn", "value": [["w", "x", 1]],
+         "process": 0, "time": 0},
+        {"type": "ok", "f": "txn", "value": [["r", "x", None]],
+         "process": 1, "time": 1},
+    ]).index()
+    txns = list(h)
+    assert graphs.realtime_edges(h, txns) == {}
+    r = wr.check(h, additional_graphs=("realtime",))
+    assert r["valid?"] is True
+    # same-process completions still chain in process order (a
+    # sequential process proves its own op order without invocations)
+    h2 = history([
+        {"type": "ok", "f": "txn", "value": [["w", "x", 1]],
+         "process": 0, "time": 0},
+        {"type": "ok", "f": "txn", "value": [["r", "x", None]],
+         "process": 0, "time": 1},
+    ]).index()
+    r2 = wr.check(h2, additional_graphs=("process",))
+    assert r2["valid?"] is False
+    assert "G-single-process" in r2["anomaly-types"]
+
+
+def test_process_chain_orders_by_completion_not_invocation():
+    # an op whose invocation was lost from the journal must not jump to
+    # the head of its process chain (completion order is op order for a
+    # sequential process)
+    h = history([
+        {"type": "invoke", "f": "txn", "value": [["w", "x", 1]],
+         "process": 0, "time": 0},
+        {"type": "ok", "f": "txn", "value": [["w", "x", 1]],
+         "process": 0, "time": 1},
+        {"type": "ok", "f": "txn", "value": [["r", "x", 1]],
+         "process": 0, "time": 2},
+    ])
+    r = wr.check(h, additional_graphs=("process",))
+    assert r["valid?"] is True
+
+
+def test_additional_edges_unknown_graph():
+    with pytest.raises(ValueError):
+        graphs.additional_edges(history([]), [], ("causal",))
+
+
+def test_expand_anomalies_variants():
+    out = graphs.expand_anomalies(("G0", "G-single", "G1a"),
+                                  ("realtime", "process"))
+    assert "G0-realtime" in out and "G0-process" in out
+    assert "G-single-realtime" in out
+    assert "G1a-realtime" not in out
+
+
+# -- kernels: union-graph classification ------------------------------------
+
+def test_analyze_edges_realtime_only_cycle():
+    edges = {(0, 1): frozenset({"realtime"}),
+             (1, 0): frozenset({"rw"})}
+    r = kernels.analyze_edges(2, edges)
+    assert r["G-single-realtime"]
+    assert not r["G-single"] and not r["G0"] and not r["G0-realtime"]
+
+
+def test_analyze_edges_process_subsumed_by_base():
+    # a pure-ww cycle also closed by a process edge: base G0 explains
+    # it, so no variant fires for that SCC
+    edges = {(0, 1): frozenset({"ww"}),
+             (1, 0): frozenset({"ww", "process"})}
+    r = kernels.analyze_edges(2, edges)
+    assert r["G0"] and not r["G0-process"]
+
+
+def test_analyze_edges_requires_subtraction_is_per_scc():
+    # SCC A: pure-ww cycle. SCC B: ww + process cycle. Both G0 and
+    # G0-process must be reported — the subtraction is per-SCC, not
+    # global.
+    edges = {(0, 1): frozenset({"ww"}), (1, 0): frozenset({"ww"}),
+             (2, 3): frozenset({"ww"}), (3, 2): frozenset({"process"})}
+    r = kernels.analyze_edges(4, edges)
+    assert r["G0"] and r["G0-process"]
+
+
+def test_analyze_edges_realtime_level_folds_process():
+    # cycle needs one process and one realtime edge: reported at the
+    # realtime level (realtime subsumes process), not the process level
+    edges = {(0, 1): frozenset({"process"}),
+             (1, 2): frozenset({"realtime"}),
+             (2, 0): frozenset({"ww"})}
+    r = kernels.analyze_edges(3, edges)
+    assert r["G0-realtime"] and not r["G0-process"] and not r["G0"]
+
+
+def test_analyze_edges_g2_variant():
+    # two rw edges, closed only through a process edge
+    edges = {(0, 1): frozenset({"rw"}),
+             (1, 2): frozenset({"process"}),
+             (2, 0): frozenset({"rw"})}
+    r = kernels.analyze_edges(3, edges)
+    assert r["G2-item-process"]
+    assert not r["G2-item"] and not r["G-single-process"]
+
+
+# -- rw-register fixtures (`tests/cycle/wr.clj`) ----------------------------
+
+def _wr_realtime_fixture():
+    # T1 writes x=1 and completes; T2 then reads nil: the stale read
+    # anti-depends on T1 (rw), and T1 realtime-precedes T2
+    return history(_ok(0, [["w", "x", 1]], 0)
+                   + _ok(1, [["r", "x", None]], 2))
+
+
+def _wr_process_fixture():
+    # same shape, same process: the precedence edge is process order
+    return history(_ok(0, [["w", "x", 1]], 0)
+                   + _ok(0, [["r", "x", None]], 2))
+
+
+def test_wr_realtime_only_cycle():
+    h = _wr_realtime_fixture()
+    assert wr.check(h)["valid?"] is True
+    r = wr.check(h, additional_graphs=("realtime",))
+    assert r["valid?"] is False
+    assert "G-single-realtime" in r["anomaly-types"]
+    cert = r["anomalies"]["G-single-realtime"][0]["cycle"]
+    assert cert is not None and cert[0] == cert[-1]
+    # the processes differ, so the process graph alone sees nothing
+    assert wr.check(h, additional_graphs=("process",))["valid?"] is True
+
+
+def test_wr_process_only_cycle():
+    h = _wr_process_fixture()
+    assert wr.check(h)["valid?"] is True
+    r = wr.check(h, additional_graphs=("process",))
+    assert r["valid?"] is False
+    assert "G-single-process" in r["anomaly-types"]
+
+
+def test_wr_process_preferred_over_realtime():
+    # with both graphs on, the weaker (process) explanation wins
+    r = wr.check(_wr_process_fixture(),
+                 additional_graphs=("realtime", "process"))
+    assert r["valid?"] is False
+    assert "G-single-process" in r["anomaly-types"]
+    assert "G-single-realtime" not in r["anomaly-types"]
+
+
+def test_wr_g0_realtime():
+    # T1 observes x=1 then writes x=2 (so ww: writer(1) -> T1) and
+    # completes before writer(1) even begins: a write-order cycle
+    # closed by realtime alone
+    h = history(_ok(0, [["r", "x", 1], ["w", "x", 2]], 0)
+                + _ok(1, [["w", "x", 1]], 2))
+    r = wr.check(h, additional_graphs=("realtime",))
+    assert r["valid?"] is False
+    assert "G0-realtime" in r["anomaly-types"]
+    cert = r["anomalies"]["G0-realtime"][0]["cycle"]
+    assert cert is not None and len(cert) == 3
+
+
+def test_wr_anomaly_filter_still_applies():
+    # realtime cycle present but the caller only asked for G1 —
+    # G-single-realtime is not in the expanded anomaly set
+    r = wr.check(_wr_realtime_fixture(), anomalies=("G1a", "G1b", "G1c"),
+                 additional_graphs=("realtime",))
+    assert r["valid?"] is True
+
+
+# -- list-append fixtures (`tests/cycle.clj`) -------------------------------
+
+def _append_realtime_fixture():
+    return history(_ok(0, [["append", "x", 1]], 0)
+                   + _ok(1, [["r", "x", []]], 2))
+
+
+def test_append_realtime_only_cycle():
+    h = _append_realtime_fixture()
+    assert list_append.check(h)["valid?"] is True
+    r = list_append.check(h, additional_graphs=("realtime",))
+    assert r["valid?"] is False
+    assert "G-single-realtime" in r["anomaly-types"]
+
+
+def test_append_process_only_cycle():
+    h = history(_ok(0, [["append", "x", 1]], 0)
+                + _ok(0, [["r", "x", []]], 2))
+    assert list_append.check(h)["valid?"] is True
+    r = list_append.check(h, additional_graphs=("process",))
+    assert r["valid?"] is False
+    assert "G-single-process" in r["anomaly-types"]
+
+
+def test_append_valid_history_stays_valid_with_graphs():
+    h = history(_ok(0, [["append", "x", 1]], 0)
+                + _ok(1, [["r", "x", [1]], ["append", "x", 2]], 2)
+                + _ok(0, [["r", "x", [1, 2]]], 4))
+    r = list_append.check(h, additional_graphs=("realtime", "process"))
+    assert r["valid?"] is True
+
+
+# -- host/device agreement --------------------------------------------------
+
+_FIXTURES = [
+    ("wr-realtime", wr.check, _wr_realtime_fixture(), ("realtime",)),
+    ("wr-process", wr.check, _wr_process_fixture(), ("process",)),
+    ("wr-both", wr.check, _wr_process_fixture(),
+     ("realtime", "process")),
+    ("append-realtime", list_append.check, _append_realtime_fixture(),
+     ("realtime",)),
+    ("wr-g0-rt", wr.check,
+     history(_ok(0, [["r", "x", 1], ["w", "x", 2]], 0)
+             + _ok(1, [["w", "x", 1]], 2)), ("realtime",)),
+]
+
+
+@pytest.mark.parametrize("name,fn,h,graphs_", _FIXTURES,
+                         ids=[f[0] for f in _FIXTURES])
+def test_host_device_engines_agree(monkeypatch, name, fn, h, graphs_):
+    monkeypatch.setenv("JEPSEN_TPU_ELLE_HOST", "1")
+    host = fn(h, additional_graphs=graphs_)
+    monkeypatch.delenv("JEPSEN_TPU_ELLE_HOST")
+    dev = fn(h, additional_graphs=graphs_)
+    assert host["valid?"] == dev["valid?"]
+    assert host["anomaly-types"] == dev["anomaly-types"]
+
+
+def test_union_rides_the_scc_device_path():
+    # a 40-txn chain with one realtime-only cycle at the end: the
+    # condensation isolates a single small SCC and the stacked-level
+    # batched classifier (device path on this CPU backend) flags only
+    # the realtime level
+    ops = []
+    for i in range(40):
+        ops += _ok(i % 4, [["w", f"k{i}", 1]], 2 * i)
+    ops += _ok(5, [["w", "z", 1]], 100)
+    ops += _ok(6, [["r", "z", None]], 102)
+    r = wr.check(history(ops), additional_graphs=("realtime",))
+    assert r["valid?"] is False
+    assert r["anomaly-types"] == ["G-single-realtime"]
+
+
+def test_analyze_edges_oversized_scc_levels():
+    # max_dense=2 forces the oversized host path; the ring closes only
+    # through its realtime edge, so only the realtime level fires
+    edges = {(i, i + 1): frozenset({"ww"}) for i in range(4)}
+    edges[(4, 0)] = frozenset({"realtime"})
+    r = kernels.analyze_edges(5, edges, max_dense=2)
+    assert r["oversized-sccs"] == 1
+    assert r["G0-realtime"] and not r["G0"]
+
+    edges2 = {(i, i + 1): frozenset({"ww"}) for i in range(3)}
+    edges2[(3, 4)] = frozenset({"rw"})
+    edges2[(4, 0)] = frozenset({"realtime"})
+    r2 = kernels.analyze_edges(5, edges2, max_dense=2)
+    assert r2["G-single-realtime"]
+    assert not r2["G-single"] and not r2["G0-realtime"]
+
+
+def test_analyze_edges_with_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("sccs",))
+    edges = {(0, 1): frozenset({"realtime"}),
+             (1, 0): frozenset({"rw"}),
+             (2, 3): frozenset({"ww"}), (3, 2): frozenset({"ww"})}
+    r = kernels.analyze_edges(4, edges, mesh=mesh)
+    assert r["G-single-realtime"] and r["G0"]
+    assert not r["G-single"]

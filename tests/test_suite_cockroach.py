@@ -161,7 +161,11 @@ def test_hermetic_suite_run(tmp_path, fake, workload):
         "ssh": {"dummy": True},
         "workload": workload,
         "rate": 500,
-        "time-limit": 3,
+        # 2s (was 3): the menu grew to 8 workloads (monotonic /
+        # sequential / comments), so each run gets a slightly tighter
+        # budget to keep the file's wall time flat; at rate 500 a 2s
+        # run still journals ~1k ops, plenty for every checker here
+        "time-limit": 2,
         "ops-per-key": 20,
         "faults": ["none"],
         "store-dir": str(tmp_path / "store"),

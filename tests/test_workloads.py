@@ -6,7 +6,9 @@ from jepsen_tpu.generator import simulate as sim
 from jepsen_tpu.history import history
 from jepsen_tpu.independent import KV
 from jepsen_tpu.workloads import (adya, bank, causal, causal_reverse,
-                                  linearizable_register, long_fork)
+                                  comments, linearizable_register,
+                                  long_fork, monotonic, sequential,
+                                  table)
 
 
 # -- bank -------------------------------------------------------------------
@@ -335,3 +337,238 @@ def test_bank_test_merges_opts():
     assert t["accounts"] == [0, 1]
     assert t["total-amount"] == 10
     assert t["max-transfer"] == 5  # default retained
+
+
+# -- additional-graphs workloads (monotonic / sequential / table /
+#    comments) run end-to-end under the deterministic simulator against
+#    a sequential in-memory store (a legal strict serialization, so
+#    every checker must say valid) -----------------------------------------
+
+
+def _register_complete(store):
+    """Fill r mops from the store; a 'w' with a nil value writes its
+    key's current value + 1 (the monotonic increment contract)."""
+    def complete(ctx, invoke):
+        out = dict(invoke)
+        out["type"] = "ok"
+        val = []
+        for m in invoke["value"]:
+            f, k, v = m[0], m[1], m[2]
+            if f == "r":
+                val.append(["r", k, store.get(k)])
+            else:
+                x = v if v is not None else (store.get(k) or 0) + 1
+                store[k] = x
+                val.append(["w", k, x])
+        out["value"] = val
+        return out
+    return complete
+
+
+def _run_workload(w, complete, n=60, concurrency=3, seed=7):
+    with gen.fixed_rng(seed):
+        h = sim.simulate(sim.n_plus_nemesis_context(concurrency),
+                         gen.clients(gen.limit(n, w["generator"])),
+                         complete)
+    return w["checker"].check({}, history(h), {})
+
+
+def test_monotonic_end_to_end():
+    w = monotonic.workload()
+    res = _run_workload(w, _register_complete({}))
+    assert res["valid?"] is True, res
+    assert res["txn-count"] == 60
+
+
+def test_monotonic_detects_stale_read():
+    # an inc completes (x: nil -> 1); a later read still sees nil
+    h = history(
+        [{"type": "invoke", "f": "inc",
+          "value": [["r", 0, None], ["w", 0, None]], "process": 0,
+          "time": 0},
+         {"type": "ok", "f": "inc",
+          "value": [["r", 0, None], ["w", 0, 1]], "process": 0,
+          "time": 1},
+         {"type": "invoke", "f": "read", "value": [["r", 0, None]],
+          "process": 1, "time": 2},
+         {"type": "ok", "f": "read", "value": [["r", 0, None]],
+          "process": 1, "time": 3}])
+    res = monotonic.workload()["checker"].check({}, h, {})
+    assert res["valid?"] is False
+    assert "G-single-realtime" in res["anomaly-types"]
+
+
+def test_sequential_end_to_end():
+    w = sequential.workload()
+    res = _run_workload(w, _register_complete({}))
+    assert res["valid?"] is True, res
+
+
+def test_sequential_generator_orders_pair_writes():
+    with gen.fixed_rng(3):
+        ops = sim.quick_ops(sim.n_plus_nemesis_context(3),
+                            gen.clients(gen.limit(
+                                40, sequential.generator())))
+    first_write = {}
+    for o in ops:
+        if o["type"] != "invoke":
+            continue
+        if o["f"] == "write":
+            k = o["value"][0][1]
+            first_write.setdefault(k, o["process"])
+        else:
+            # reads probe the pair in reverse order
+            ks = [m[1] for m in o["value"]]
+            assert ks[0] == ks[1] + 1
+    for i in range(0, max(first_write, default=0), 2):
+        if i + 1 in first_write:
+            # the second write of a pair comes from the thread that
+            # wrote the first (process may bump after crashes, but the
+            # quick harness never crashes)
+            assert first_write[i + 1] == first_write[i]
+
+
+def test_sequential_detects_reversed_visibility():
+    # process 0 writes k0 then k1; a reader sees k1's value but not k0
+    h = history(
+        _lf_write(0, 0, 0)[:1]
+        + [{"type": "ok", "f": "write", "value": [["w", 0, 1]],
+            "process": 0, "time": 1},
+           {"type": "invoke", "f": "write", "value": [["w", 1, 1]],
+            "process": 0, "time": 2},
+           {"type": "ok", "f": "write", "value": [["w", 1, 1]],
+            "process": 0, "time": 3},
+           {"type": "invoke", "f": "read",
+            "value": [["r", 1, None], ["r", 0, None]], "process": 1,
+            "time": 4},
+           {"type": "ok", "f": "read",
+            "value": [["r", 1, 1], ["r", 0, None]], "process": 1,
+            "time": 5}])
+    res = sequential.workload()["checker"].check({}, h, {})
+    assert res["valid?"] is False
+    assert "G-single-process" in res["anomaly-types"]
+
+
+def _table_complete(created):
+    def complete(ctx, invoke):
+        out = dict(invoke)
+        if invoke["f"] == "create-table":
+            created.add(invoke["value"])
+            out["type"] = "ok"
+        elif invoke["value"][0] in created:
+            out["type"] = "ok"
+        else:
+            out["type"] = "fail"
+            out["error"] = ["table-missing", invoke["value"][0]]
+        return out
+    return complete
+
+
+def test_table_end_to_end():
+    w = table.workload()
+    res = _run_workload(w, _table_complete(set()))
+    assert res["valid?"] is True, res
+    assert res["table-count"] >= 1
+
+
+def test_table_detects_missing_after_create():
+    h = history(
+        [{"type": "invoke", "f": "create-table", "value": 0,
+          "process": 0, "time": 0},
+         {"type": "ok", "f": "create-table", "value": 0, "process": 0,
+          "time": 1},
+         {"type": "invoke", "f": "insert", "value": [0, 7],
+          "process": 1, "time": 2},
+         {"type": "fail", "f": "insert", "value": [0, 7], "process": 1,
+          "time": 3, "error": ["table-missing", 0]}])
+    res = table.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert len(res["missing-after-create"]) == 1
+
+
+def test_table_allows_racing_insert_failure():
+    # the insert was invoked before the create completed: no anomaly
+    h = history(
+        [{"type": "invoke", "f": "create-table", "value": 0,
+          "process": 0, "time": 0},
+         {"type": "invoke", "f": "insert", "value": [0, 7],
+          "process": 1, "time": 1},
+         {"type": "ok", "f": "create-table", "value": 0, "process": 0,
+          "time": 2},
+         {"type": "fail", "f": "insert", "value": [0, 7], "process": 1,
+          "time": 3, "error": ["table-missing", 0]}])
+    assert table.checker().check({}, h, {})["valid?"] is True
+
+
+def _comments_complete(store):
+    def complete(ctx, invoke):
+        out = dict(invoke)
+        out["type"] = "ok"
+        if invoke["f"] == "write":
+            store.add(invoke["value"])
+        else:
+            out["value"] = sorted(store)
+        return out
+    return complete
+
+
+def test_comments_end_to_end():
+    w = comments.workload()
+    res = _run_workload(w, _comments_complete(set()))
+    assert res["valid?"] is True, res
+    assert res["read-count"] + res["write-count"] > 0
+
+
+def test_comments_detects_realtime_gap():
+    # write 0 completes before write 1 begins; a read concurrent with
+    # write 0 sees 1 but not 0 — a pure ordering gap, not a stale read
+    h = history(
+        [{"type": "invoke", "f": "write", "value": 0, "process": 0,
+          "time": 0},
+         {"type": "invoke", "f": "read", "value": None, "process": 2,
+          "time": 1},
+         {"type": "ok", "f": "write", "value": 0, "process": 0,
+          "time": 2},
+         {"type": "invoke", "f": "write", "value": 1, "process": 1,
+          "time": 3},
+         {"type": "ok", "f": "write", "value": 1, "process": 1,
+          "time": 4},
+         {"type": "ok", "f": "read", "value": [1], "process": 2,
+          "time": 5}])
+    res = comments.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert len(res["realtime-gaps"]) == 1
+
+
+def test_comments_detects_stale_read():
+    # write 0 completed before the read even began, yet it's missing
+    h = history(
+        [{"type": "invoke", "f": "write", "value": 0, "process": 0,
+          "time": 0},
+         {"type": "ok", "f": "write", "value": 0, "process": 0,
+          "time": 1},
+         {"type": "invoke", "f": "read", "value": None, "process": 2,
+          "time": 2},
+         {"type": "ok", "f": "read", "value": [], "process": 2,
+          "time": 3}])
+    res = comments.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert len(res["stale-reads"]) == 1
+
+
+def test_comments_concurrent_miss_is_legal():
+    # both writes overlap the read: seeing either subset is fine
+    h = history(
+        [{"type": "invoke", "f": "write", "value": 0, "process": 0,
+          "time": 0},
+         {"type": "invoke", "f": "write", "value": 1, "process": 1,
+          "time": 1},
+         {"type": "invoke", "f": "read", "value": None, "process": 2,
+          "time": 2},
+         {"type": "ok", "f": "write", "value": 0, "process": 0,
+          "time": 3},
+         {"type": "ok", "f": "write", "value": 1, "process": 1,
+          "time": 4},
+         {"type": "ok", "f": "read", "value": [1], "process": 2,
+          "time": 5}])
+    assert comments.checker().check({}, h, {})["valid?"] is True
