@@ -85,13 +85,38 @@ def unbridled_optimism() -> Checker:
     return _UnbridledOptimism()
 
 
-def check_safe(checker, test, hist, opts=None) -> dict:
+def checker_name(checker) -> str:
+    """A human-readable name for a checker, for error attribution."""
+    c = checker
+    if isinstance(c, FnChecker):
+        c = c.fn
+    if isinstance(c, Checker):
+        return type(c).__name__
+    return getattr(c, "__name__", None) or type(c).__name__
+
+
+def check_safe(checker, test, hist, opts=None, name=None) -> dict:
     """check(), but exceptions come back as {'valid?': 'unknown', ...}
-    (reference checker.clj:74-85)."""
+    (reference checker.clj:74-85). The payload names the checker that
+    failed ('checker') so a traceback inside compose stays
+    attributable. A RuntimeError — how jax surfaces backend/XLA
+    failures (device init, device OOM) — additionally reports
+    'degraded': True: the checker didn't find an anomaly, the device
+    path fell over underneath it."""
+    cname = name if name is not None else checker_name(checker)
     try:
         return coerce(checker).check(test, history(hist), opts or {})
+    except (NotImplementedError, RecursionError):
+        # RuntimeError subclasses, but ordinary checker bugs — not a
+        # backend falling over
+        return {"valid?": UNKNOWN, "checker": cname,
+                "error": traceback.format_exc()}
+    except RuntimeError:
+        return {"valid?": UNKNOWN, "checker": cname, "degraded": True,
+                "error": traceback.format_exc()}
     except Exception:  # noqa: BLE001 — checker crashes must not kill the run
-        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
+        return {"valid?": UNKNOWN, "checker": cname,
+                "error": traceback.format_exc()}
 
 
 class Compose(Checker):
@@ -105,7 +130,8 @@ class Compose(Checker):
         hist = history(hist)
         items = list(self.checkers.items())
         results = bounded_pmap(
-            lambda kv: (kv[0], check_safe(kv[1], test, hist, opts)),
+            lambda kv: (kv[0], check_safe(kv[1], test, hist, opts,
+                                          name=kv[0])),
             items, max_workers=8)
         out: dict = dict(results)
         out["valid?"] = merge_valid(
@@ -147,7 +173,8 @@ from .perf import latency_graph, perf_checker  # noqa: E402
 from .perf import rate_graph_checker as rate_graph  # noqa: E402
 
 __all__ = [
-    "Checker", "UNKNOWN", "merge_valid", "check_safe", "compose",
+    "Checker", "UNKNOWN", "merge_valid", "check_safe", "checker_name",
+    "compose",
     "concurrency_limit", "noop", "unbridled_optimism", "coerce",
     "stats", "unhandled_exceptions", "set_checker", "set_full", "queue",
     "total_queue", "unique_ids", "counter", "counter_plot",

@@ -307,6 +307,14 @@ def single_test_cmd(opts: dict) -> dict:
             raise RuntimeError(
                 f"Stored test ({stored.get('name')}) and CLI test "
                 f"({cli_test.get('name')}) have different names; aborting")
+        if stored.get("salvaged-from-journal"):
+            # crashed/killed run: the checkable prefix came from the
+            # write-ahead journal; its tail may be pending invocations
+            h = stored["history"]  # load_test set it alongside the flag
+            log.warning(
+                "analyzing a history salvaged from journal.jsonl "
+                "(%d ops, %d pending invocations); the run died before "
+                "writing history.jsonl.gz", len(h), len(h.pending()))
         stored.pop("results", None)
         test = {**cli_test, **stored}
         core.analyze(test)

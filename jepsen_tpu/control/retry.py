@@ -2,9 +2,15 @@
 
 Reference: `jepsen/src/jepsen/control/retry.clj` — wraps a Remote in a
 stateful auto-reconnecting connection and retries failed operations
-**5 times with ~100 ms backoff** (`retry.clj:15-30`), because transient
-SSH failures (EOFs, dropped channels, slow sshds) are routine during
-fault injection.
+**5 times** (`retry.clj:15-30`), because transient SSH failures (EOFs,
+dropped channels, slow sshds) are routine during fault injection.
+
+Delays follow capped exponential backoff with *decorrelated jitter*
+(sleep = min(cap, U(base, 3·prev))) instead of the reference's fixed
+~100 ms: when a partition heals, N workers all lost their connections
+at the same instant, and a fixed delay has them retrying in lockstep —
+hammering the node's sshd in synchronized waves. Jitter spreads them
+out; the cap bounds the worst-case wait.
 
 Commands that fail with a *nonzero exit status* are NOT retried — that's
 a real result, not transport trouble. Only transport-level exceptions
@@ -13,27 +19,48 @@ trigger reconnect+retry.
 
 from __future__ import annotations
 
+import random as _random
 import threading
 import time
+from typing import Iterator
 
 from .core import Remote, RemoteError
 
 RETRIES = 5
-BACKOFF_S = 0.1
+BACKOFF_S = 0.1       # base (and first) delay
+BACKOFF_CAP_S = 2.0   # delays never exceed this
+
+
+def backoff(base_s: float = BACKOFF_S, cap_s: float = BACKOFF_CAP_S,
+            rng: _random.Random | None = None) -> Iterator[float]:
+    """Infinite generator of retry delays: base first, then
+    decorrelated jitter — sleep = min(cap, U(base, 3·prev)) (the AWS
+    "exponential backoff and jitter" scheme). Every delay lies in
+    [base, cap]. Pass a seeded rng for a deterministic schedule."""
+    u = (rng or _random).uniform
+    sleep = base_s
+    while True:
+        yield sleep
+        sleep = min(cap_s, u(base_s, sleep * 3))
 
 
 class RetryRemote(Remote):
     def __init__(self, inner: Remote, retries: int = RETRIES,
-                 backoff_s: float = BACKOFF_S):
+                 backoff_s: float = BACKOFF_S,
+                 backoff_cap_s: float = BACKOFF_CAP_S,
+                 rng: _random.Random | None = None):
         self.inner = inner          # unconnected prototype
         self.retries = retries
         self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.rng = rng
         self.conn_spec = None
         self._conn: Remote | None = None
         self._lock = threading.Lock()
 
     def connect(self, conn_spec: dict) -> "RetryRemote":
-        r = RetryRemote(self.inner, self.retries, self.backoff_s)
+        r = RetryRemote(self.inner, self.retries, self.backoff_s,
+                        self.backoff_cap_s, self.rng)
         r.conn_spec = dict(conn_spec)
         r._conn = self.inner.connect(conn_spec)
         return r
@@ -56,6 +83,7 @@ class RetryRemote(Remote):
 
     def _with_retry(self, f):
         last = None
+        delays = backoff(self.backoff_s, self.backoff_cap_s, self.rng)
         for attempt in range(self.retries + 1):
             conn = self._conn
             if conn is None:
@@ -63,7 +91,7 @@ class RetryRemote(Remote):
                     conn = self._reconnect()
                 except Exception as e:
                     last = e
-                    time.sleep(self.backoff_s)
+                    time.sleep(next(delays))
                     continue
             try:
                 return f(conn)
@@ -74,7 +102,7 @@ class RetryRemote(Remote):
                 last = e
             except Exception as e:
                 last = e
-            time.sleep(self.backoff_s)
+            time.sleep(next(delays))
             try:
                 self._reconnect()
             except Exception as e:

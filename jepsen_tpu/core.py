@@ -42,7 +42,18 @@ log = logging.getLogger(__name__)
 
 NO_BARRIER = "no-barrier"
 
+# Shutdown-path deadlines (seconds): faults are injected on purpose, so
+# a dead node must not be able to hang teardown or log collection.
+# Override per test with 'teardown-timeout' / 'snarf-timeout'.
+TEARDOWN_TIMEOUT_S = 60.0
+SNARF_TIMEOUT_S = 300.0
+
 _snarf_lock = threading.Lock()
+
+
+def _deadline_s(test: dict, key: str, default: float) -> float:
+    v = test.get(key)
+    return default if v is None else float(v)
 
 
 def synchronize(test: dict, timeout_s: float = 60) -> None:
@@ -121,27 +132,42 @@ def _short_paths(full_paths: list[str]) -> dict[str, str]:
 
 def snarf_logs(test: dict) -> None:
     """Download DB log files for each node into the store directory and
-    refresh symlinks (`core.clj:102-136`)."""
-    with _snarf_lock:
-        db = test["db"]
-        if jdb.supports(db, "log-files") and test.get("sessions"):
-            log.info("Snarfing log files")
+    refresh symlinks (`core.clj:102-136`). Downloads run under a
+    'snarf-timeout' deadline: this is shutdown-path code, and a dead
+    node's hung sftp must not wedge the run that was busy killing it.
+    _snarf_lock is taken *inside* the deadlined thread, so an abandoned
+    (timed out but still downloading) snarf keeps excluding the next
+    one — two snarfs interleaving into the same local files is exactly
+    what the lock exists to prevent."""
+    db = test["db"]
+    if jdb.supports(db, "log-files") and test.get("sessions"):
+        log.info("Snarfing log files")
 
-            def snarf1(test, node):
-                full_paths = list(db.log_files(test, node) or [])
-                for remote, local in _short_paths(full_paths).items():
-                    if cu.exists(remote):
-                        dest = store.make_path(
-                            test, str(node), local.lstrip("/"))
-                        log.info("downloading %s to %s", remote, dest)
-                        try:
-                            control.download(remote, dest)
-                        except OSError as e:
-                            log.info("%s: %s", remote, e)
+        def snarf1(test, node):
+            full_paths = list(db.log_files(test, node) or [])
+            for remote, local in _short_paths(full_paths).items():
+                if cu.exists(remote):
+                    dest = store.make_path(
+                        test, str(node), local.lstrip("/"))
+                    log.info("downloading %s to %s", remote, dest)
+                    try:
+                        control.download(remote, dest)
+                    except OSError as e:
+                        log.info("%s: %s", remote, e)
 
-            control.on_nodes(test, snarf1)
-        if test.get("name"):
-            store.update_symlinks(test)
+        def snarf_all():
+            with _snarf_lock:
+                control.on_nodes(test, snarf1)
+
+        t_s = _deadline_s(test, "snarf-timeout", SNARF_TIMEOUT_S)
+        if util.timeout(t_s, snarf_all,
+                        default=util.TIMED_OUT) is util.TIMED_OUT:
+            log.warning("log snarfing still running after %ss; "
+                        "abandoning it and continuing shutdown", t_s)
+    if test.get("name"):
+        # racing an abandoned snarf is fine: update_symlinks tolerates
+        # concurrent callers (symlink errors pass)
+        store.update_symlinks(test)
 
 
 def maybe_snarf_logs(test: dict) -> None:
@@ -179,46 +205,85 @@ def with_db(test: dict):
             control.on_nodes(test, test["db"].teardown)
 
 
+def _spawn(fn, box: list, name: str) -> threading.Thread:
+    """Run fn on a daemon thread, capturing ('ok', value) or ('err', e)
+    into box. Daemon (not a pool worker) so an abandoned hang can never
+    block interpreter exit."""
+    def run():
+        try:
+            box.append(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 — surfaced via box
+            box.append(("err", e))
+
+    t = threading.Thread(target=run, name=name, daemon=True)
+    t.start()
+    return t
+
+
 @contextlib.contextmanager
 def with_client_nemesis_setup_teardown(test: dict):
     """Set up the nemesis (concurrently) and one client per node before
     the body; tear them all down after (`core.clj:183-212`). The set-up
     nemesis replaces test['nemesis'] so the interpreter drives the
-    initialized instance."""
-    import concurrent.futures as _futures
+    initialized instance.
 
+    Teardown runs under 'teardown-timeout' deadlines: client teardown,
+    client close, and nemesis teardown are each bounded, so one dead
+    node can't hang the shutdown path (the hung call is abandoned per
+    util.timeout semantics and logged)."""
     client = test["client"]
     nemesis = jnemesis.validate(test["nemesis"])
+    t_s = _deadline_s(test, "teardown-timeout", TEARDOWN_TIMEOUT_S)
 
     def open1(node):
         c = client.open(test, node)
         c.setup(test)
         return c
 
-    with _futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="jepsen nemesis") as pool:
-        nf = pool.submit(nemesis.setup, test)
-        try:
-            clients = util.real_pmap(open1, test["nodes"])
-        except BaseException:
-            nf.cancel()
-            raise
-        test = {**test, "nemesis": nf.result() or nemesis}
-        try:
-            yield test
-        finally:
-            nt = pool.submit(test["nemesis"].teardown, test)
+    nbox: list = []
+    nth = _spawn(lambda: nemesis.setup(test), nbox, "jepsen nemesis")
+    try:
+        clients = util.real_pmap(open1, test["nodes"])
+    except BaseException:
+        # wait out an in-flight nemesis setup before propagating, so
+        # the enclosing teardown never runs concurrently with it
+        nth.join()
+        if nbox and nbox[0][0] == "err":
+            log.warning("nemesis setup also failed: %s", nbox[0][1])
+        raise
+    nth.join()
+    tag, val = nbox[0]
+    if tag == "err":
+        raise val
+    test = {**test, "nemesis": val or nemesis}
+    try:
+        yield test
+    finally:
+        tbox: list = []
+        tth = _spawn(lambda: test["nemesis"].teardown(test), tbox,
+                     "jepsen nemesis teardown")
 
-            def close1(c):
-                try:
-                    c.teardown(test)
-                finally:
-                    c.close(test)
-
+        def close1(c):
             try:
-                util.real_pmap(close1, clients)
+                if util.timeout(t_s, lambda: c.teardown(test),
+                                default=util.TIMED_OUT) is util.TIMED_OUT:
+                    log.warning("client teardown timed out after %ss; "
+                                "abandoning it", t_s)
             finally:
-                nt.result()
+                if util.timeout(t_s, lambda: c.close(test),
+                                default=util.TIMED_OUT) is util.TIMED_OUT:
+                    log.warning("client close timed out after %ss; "
+                                "abandoning it", t_s)
+
+        try:
+            util.real_pmap(close1, clients)
+        finally:
+            tth.join(t_s)
+            if tth.is_alive():
+                log.warning("nemesis teardown still running after %ss; "
+                            "abandoning it", t_s)
+            elif tbox and tbox[0][0] == "err":
+                raise tbox[0][1]
 
 
 def run_case(test: dict) -> History:
@@ -226,6 +291,29 @@ def run_case(test: dict) -> History:
     (`core.clj:214-219`)."""
     with with_client_nemesis_setup_teardown(test) as test:
         return interpreter.run(test)
+
+
+def _salvage_journal(test: dict) -> None:
+    """Persist the journal-backed history prefix when the run dies
+    before its normal save_1 — checking can always be re-run, but a
+    lost history cannot be regenerated. Never raises: the root-cause
+    exception is already on its way up."""
+    if not test.get("name"):
+        return
+    try:
+        part = store.load_journal(test)
+        if part is None:
+            return
+        done = {k: v for k, v in test.items()
+                if k not in ("barrier", "sessions")}
+        done["history"] = part
+        log.warning("run crashed with %d journaled ops (%d pending "
+                    "invocations); writing salvaged history",
+                    len(part), len(part.pending()))
+        store.save_1(done)
+    except Exception:  # noqa: BLE001 — must not mask the root cause
+        log.warning("failed to salvage journal-backed history",
+                    exc_info=True)
 
 
 def analyze(test: dict) -> dict:
@@ -334,7 +422,13 @@ def run(test: dict) -> dict:
         with with_sessions(test) as stest:
             with with_os(stest), with_db(stest):
                 with util.relative_time():
-                    hist = run_case(stest)
+                    try:
+                        hist = run_case(stest)
+                    except BaseException:
+                        # the journal-backed prefix is still written
+                        # even when the run itself dies
+                        _salvage_journal(stest)
+                        raise
                 # strip run-state the analysis/persistence layers must
                 # not see (reference dissoc, core.clj:393-395)
                 done = {k: v for k, v in stest.items()

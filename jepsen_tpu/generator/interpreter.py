@@ -17,6 +17,17 @@ Worker behavior (`interpreter.clj:99-164`):
     the client is `reusable` (`ClientWorker`, `:33-67`),
   * :sleep and :log ops are handled in the worker and kept out of the
     history (`goes-in-history?`, `:171-178`).
+
+Run survivability (beyond the reference):
+  * every history op is appended to a write-ahead journal
+    (store.Journal) as it happens, so a crashed or SIGKILL'd run
+    leaves a replayable prefix on disk,
+  * a test-level 'op-timeout' (seconds; per-op 'deadline' override)
+    bounds every invoke: an overdue op gets a synthetic :info
+    completion, its process is retired exactly like a crash, the
+    wedged worker thread is abandoned and replaced, and the late real
+    completion — should the abandoned worker ever answer — is
+    discarded. A hung client can therefore never wedge the run.
 """
 
 from __future__ import annotations
@@ -28,8 +39,9 @@ import time as _time
 from typing import Optional
 
 from .. import client as jclient
+from .. import store
 from ..history import History
-from ..util import relative_time_nanos
+from ..util import relative_time_nanos, secs_to_nanos
 from . import (NEMESIS, PENDING, context, friendly_exceptions,
                next_process, process_to_thread, validate)
 from . import op as gen_op
@@ -102,6 +114,22 @@ class NemesisWorker(Worker):
         return jnemesis.Validate(test["nemesis"]).invoke(test, op)
 
 
+class RetiredNemesisWorker(Worker):
+    """Seated when a nemesis invoke exceeds its deadline. There is only
+    ONE nemesis object and it is single-threaded by contract — the
+    wedged thread still owns it, so unlike a client (which gets a fresh
+    connection) the nemesis cannot be reopened. Subsequent nemesis ops
+    complete as :info without touching it: the run keeps terminating,
+    fault injection honestly stops."""
+
+    def invoke(self, test, op):
+        out = dict(op)
+        out["type"] = "info"
+        out["error"] = ("nemesis-retired: a prior nemesis op exceeded "
+                        "its deadline")
+        return out
+
+
 class ClientNemesisWorker(Worker):
     """Spawns ClientWorkers for integer ids (round-robin over nodes) and a
     NemesisWorker for the nemesis (`interpreter.clj:77-95`)."""
@@ -118,12 +146,17 @@ def goes_in_history(op: dict) -> bool:
 
 
 class _WorkerThread:
+    """Completions are tagged with the emitting _WorkerThread so the
+    scheduler can tell a live worker's answer from the late answer of
+    an abandoned (timed-out) one and discard the latter."""
+
     def __init__(self, test: dict, out: queue.Queue, worker: Worker, wid):
         self.id = wid
         self.inbox: queue.Queue = queue.Queue(1)
         self.test = test
         self.out = out
         self.worker = worker
+        self.abandoned = False
         self.thread = threading.Thread(
             target=self._run, name=f"jepsen-worker-{wid}", daemon=True)
         self.thread.start()
@@ -140,36 +173,96 @@ class _WorkerThread:
                 try:
                     if t == "sleep":
                         _time.sleep(op["value"])
-                        self.out.put(op)
+                        self.out.put((self, op))
                     elif t == "log":
                         LOG.info("%s", op["value"])
-                        self.out.put(op)
+                        self.out.put((self, op))
                     else:
-                        self.out.put(worker.invoke(test, op))
+                        self.out.put((self, worker.invoke(test, op)))
                 except BaseException as e:
                     LOG.warning("process %r crashed: %s",
                                 op.get("process"), e)
                     out = dict(op)
                     out["type"] = "info"
                     out["error"] = f"indeterminate: {e}"
-                    self.out.put(out)
+                    self.out.put((self, out))
         finally:
             worker.close(test)
+
+
+def _op_deadline(test: dict, op: dict, now: int):
+    """(absolute-deadline-ns, timeout-s) for an op dispatched at `now`,
+    or None when it is unbounded. The per-op 'deadline' key (seconds
+    from dispatch) overrides the test-level 'op-timeout'; an explicit
+    'deadline': None exempts one op (a deliberately long nemesis
+    transition) from the test-level bound. Anchored at dispatch time,
+    not the generator-scheduled op['time'], so scheduler lag never
+    eats into the client's budget. :sleep/:log ops complete
+    deterministically and are never deadlined."""
+    if op.get("type") in ("sleep", "log"):
+        return None
+    t = op.get("deadline", test.get("op-timeout"))
+    if t is None:
+        return None
+    return now + secs_to_nanos(t), t
 
 
 def run(test: dict) -> History:
     """Evaluate all ops from test['generator'], applying them with
     test['client'] / test['nemesis']. Returns the history
-    (`interpreter.clj:181-310`)."""
+    (`interpreter.clj:181-310`). History ops are journaled to
+    journal.jsonl as they happen (when the test has a store identity),
+    and in-flight ops are bounded by 'op-timeout' / per-op 'deadline'
+    so a wedged client can't hang the run — see the module docstring."""
     ctx = context(test)
     completions: queue.Queue = queue.Queue()
-    workers = [_WorkerThread(test, completions, ClientNemesisWorker(), t)
-               for t in ctx.workers]
-    inboxes = {w.id: w.inbox for w in workers}
+    workers = {t: _WorkerThread(test, completions, ClientNemesisWorker(), t)
+               for t in ctx.workers}
     gen = validate(friendly_exceptions(test.get("generator")))
     outstanding = 0
     poll_timeout_us = 0
     history: list = []
+    # thread -> (op, absolute-deadline-ns, timeout-s); only ops that
+    # actually carry a deadline are tracked, so runs without
+    # 'op-timeout' pay nothing on the hot path
+    deadlines: dict = {}
+    op_timeout = test.get("op-timeout")
+    journal = store.open_journal(test)
+
+    def record(o: dict) -> None:
+        history.append(o)
+        if journal is not None:
+            journal.append(o)
+
+    def deadline_capped(us: int, now: int) -> int:
+        # never sleep past the nearest in-flight deadline
+        if not deadlines:
+            return us
+        nearest = min(dl for _, dl, _ in deadlines.values())
+        return max(1, min(us, (nearest - now) // 1000))
+
+    def settle(thread, op2: dict, now: int) -> dict:
+        """The one completion transition, shared by real completions
+        and synthetic op-timeout :infos so the two can never diverge:
+        free the thread, update the generator, retire the process on
+        :info, journal, decrement outstanding."""
+        nonlocal ctx, gen, outstanding
+        if deadlines:
+            deadlines.pop(thread, None)
+        op2 = dict(op2)
+        op2["time"] = now
+        ctx = ctx.with_time(now).free(thread)
+        # update sees the free thread but the *old* process so
+        # thread->process still resolves this event
+        gen = gen_update(gen, test, ctx, op2)
+        if thread != NEMESIS and op2.get("type") == "info":
+            workers_map = dict(ctx.workers)
+            workers_map[thread] = next_process(ctx, thread)
+            ctx = ctx.with_workers(workers_map)
+        if goes_in_history(op2):
+            record(op2)
+        outstanding -= 1
+        return op2
 
     try:
         while True:
@@ -177,30 +270,72 @@ def run(test: dict) -> History:
             # introduces false concurrency.
             try:
                 if poll_timeout_us > 0:
-                    op2 = completions.get(timeout=poll_timeout_us / 1e6)
+                    src, op2 = completions.get(
+                        timeout=poll_timeout_us / 1e6)
                 else:
-                    op2 = completions.get_nowait()
+                    src, op2 = completions.get_nowait()
             except queue.Empty:
-                op2 = None
+                src = op2 = None
 
             if op2 is not None:
-                thread = process_to_thread(ctx, op2["process"])
-                now = relative_time_nanos()
-                op2 = dict(op2)
-                op2["time"] = now
-                ctx = ctx.with_time(now).free(thread)
-                # update sees the free thread but the *old* process so
-                # thread->process still resolves this event
-                gen = gen_update(gen, test, ctx, op2)
-                if thread != NEMESIS and op2.get("type") == "info":
-                    workers_map = dict(ctx.workers)
-                    workers_map[thread] = next_process(ctx, thread)
-                    ctx = ctx.with_workers(workers_map)
-                if goes_in_history(op2):
-                    history.append(op2)
-                outstanding -= 1
+                if src.abandoned or src is not workers.get(src.id):
+                    # a timed-out worker eventually answered: its op was
+                    # already journaled as :info — the late result must
+                    # be discarded, not double-completed
+                    LOG.info("discarding late completion from retired "
+                             "worker %r: %r", src.id, op2.get("f"))
+                    poll_timeout_us = 0
+                    continue
+                settle(process_to_thread(ctx, op2["process"]), op2,
+                       relative_time_nanos())
                 poll_timeout_us = 0
                 continue
+
+            # Overdue ops — checked only once the completion queue is
+            # drained, so an answer that beat its deadline is never
+            # discarded in favor of a synthetic timeout. A wedged
+            # worker still can't stall the run: an empty poll lands
+            # here within MAX_PENDING_INTERVAL_US.
+            if deadlines:
+                now = relative_time_nanos()
+                overdue = [(t, o, ts) for t, (o, dl, ts)
+                           in deadlines.items() if now >= dl]
+                if overdue:
+                    for thread, op1, timeout_s in overdue:
+                        LOG.warning(
+                            "process %r exceeded its %.3gs op deadline; "
+                            "recording :info and retiring worker %r",
+                            op1.get("process"), timeout_s, thread)
+                        settle(thread,
+                               {**op1, "type": "info",
+                                "error": ["op-timeout", timeout_s]},
+                               now)
+                        # abandon the wedged worker (its late answer is
+                        # discarded above) and seat a replacement; if it
+                        # ever unwedges, the queued exit lets it close.
+                        # Clients reopen fresh for the new process; the
+                        # single shared nemesis can't, so its
+                        # replacement answers :info without touching it
+                        old = workers[thread]
+                        old.abandoned = True
+                        # displace any undelivered op first (the worker
+                        # may have wedged before dequeuing it) so the
+                        # exit sentinel always lands and close() runs
+                        try:
+                            old.inbox.get_nowait()
+                        except queue.Empty:
+                            pass
+                        try:
+                            old.inbox.put_nowait({"type": "exit"})
+                        except queue.Full:
+                            pass
+                        replacement = (RetiredNemesisWorker()
+                                       if thread == NEMESIS
+                                       else ClientNemesisWorker())
+                        workers[thread] = _WorkerThread(
+                            test, completions, replacement, thread)
+                    poll_timeout_us = 0
+                    continue
 
             now = relative_time_nanos()
             ctx = ctx.with_time(now)
@@ -209,9 +344,9 @@ def run(test: dict) -> History:
                 if outstanding > 0:
                     poll_timeout_us = MAX_PENDING_INTERVAL_US
                     continue
-                for w in workers:
+                for w in workers.values():
                     w.inbox.put({"type": "exit"})
-                for w in workers:
+                for w in workers.values():
                     w.thread.join()
                 return History(history)
 
@@ -223,19 +358,24 @@ def run(test: dict) -> History:
                 continue
             if now < op["time"]:
                 # not yet time for this op; sleep-poll until then
-                poll_timeout_us = max(1, (op["time"] - now) // 1000)
+                poll_timeout_us = deadline_capped(
+                    max(1, (op["time"] - now) // 1000), now)
                 continue
             thread = process_to_thread(ctx, op["process"])
-            inboxes[thread].put(op)
+            workers[thread].inbox.put(op)
             ctx = ctx.with_time(op["time"]).busy(thread)
             gen = gen_update(gen1, test, ctx, op)
             if goes_in_history(op):
-                history.append(op)
+                record(op)
+            if op_timeout is not None or "deadline" in op:
+                dl = _op_deadline(test, op, now)
+                if dl is not None:
+                    deadlines[thread] = (op, dl[0], dl[1])
             outstanding += 1
             poll_timeout_us = 0
     except BaseException:
         LOG.info("shutting down workers after abnormal exit")
-        for w in workers:
+        for w in workers.values():
             # the 1-slot inbox may still hold an undelivered op; displace
             # it so the exit sentinel always lands
             try:
@@ -246,6 +386,11 @@ def run(test: dict) -> History:
                 w.inbox.put_nowait({"type": "exit"})
             except queue.Full:
                 pass
-        for w in workers:
+        for w in workers.values():
             w.thread.join(timeout=5)
         raise
+    finally:
+        # flush + close the write-ahead journal on every exit path: the
+        # on-disk prefix is the run's crash-surviving record
+        if journal is not None:
+            journal.close()

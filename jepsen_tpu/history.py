@@ -216,6 +216,16 @@ class History(Sequence):
         fs = set(fs)
         return self.filter(lambda o: o["f"] in fs)
 
+    def pending(self) -> "History":
+        """Client invocations with no completion — the open tail a
+        crash, SIGKILL, or op-timeout leaves behind. A salvaged journal
+        ends with these; checkers treat them as indeterminate, so the
+        prefix stays checkable (cf. P-compositional checking)."""
+        pairs = self.pair_index()
+        return History(o for i, o in enumerate(self.ops)
+                       if is_invoke(o) and isinstance(o["process"], int)
+                       and i not in pairs)
+
     def without_failures(self) -> "History":
         """Drop :fail completions and their invocations — failed ops are
         known to have not taken effect (knossos semantics)."""

@@ -9,6 +9,7 @@ save/load) and grows renderer/browser support in the reporting layer.
 
 from __future__ import annotations
 
+import collections as _collections
 import datetime as _dt
 import gzip
 import json
@@ -17,7 +18,9 @@ import os
 import threading as _threading
 from typing import Any, Iterable
 
-from .history import History, history
+from .history import INFO, NEMESIS, History, history
+
+log = logging.getLogger(__name__)
 
 DEFAULT_BASE = "store"
 
@@ -101,6 +104,150 @@ def read_history(p: str) -> History:
 
 def load_history(test) -> History:
     return read_history(path(test, "history.jsonl.gz"))
+
+
+# -- write-ahead op journal -------------------------------------------------
+#
+# Faults are injected on purpose, so the harness itself must survive
+# them: a SIGKILL'd or crashed run may never reach save_1, and a lost
+# history cannot be regenerated (checking always can be re-run).
+# The interpreter therefore appends every history op to journal.jsonl
+# as it happens; read_journal replays the surviving prefix.
+
+JOURNAL_FLUSH_INTERVAL_S = 0.25
+
+
+class Journal:
+    """Append-only write-ahead log of ops, one JSON object per line.
+
+    append() is called from the interpreter's scheduler hot path, so it
+    only enqueues the op (a lock-free deque push); a background writer
+    thread serializes and writes the queue every flush interval. :info
+    and nemesis ops — the ops a post-mortem most needs, crashes and
+    fault transitions — are drained and flushed *synchronously* on
+    append. flush() pushes data to the OS, so the journal survives the
+    *process* dying at any moment; it does not fsync, so a kernel panic
+    may still lose the last interval's ops."""
+
+    def __init__(self, path: str,
+                 flush_interval_s: float = JOURNAL_FLUSH_INTERVAL_S):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.flush_interval_s = flush_interval_s
+        self._fh = open(path, "a", buffering=64 * 1024)
+        self._buf: _collections.deque = _collections.deque()
+        self._io = _threading.Lock()
+        self._closed = False
+        self._wake = _threading.Event()
+        self._writer = _threading.Thread(
+            target=self._write_loop, name="jepsen-journal", daemon=True)
+        self._writer.start()
+
+    def append(self, op: dict) -> None:
+        if self._closed:
+            return
+        self._buf.append(op)
+        if op.get("type") == INFO or op.get("process") == NEMESIS:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._io:
+            self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        if self._fh is None:
+            return
+        try:
+            while True:
+                try:
+                    op = self._buf.popleft()
+                except IndexError:
+                    break
+                self._fh.write(
+                    json.dumps(op, default=_json_default) + "\n")
+            self._fh.flush()
+        except (OSError, ValueError) as e:
+            # the WAL is best-effort protection and must never abort an
+            # otherwise-healthy run (a full disk would otherwise kill
+            # the run from inside the scheduler). Disable journaling,
+            # loudly, and let the run finish — its in-memory history
+            # still reaches save_1.
+            log.warning("journal %s failed (%s); disabling the "
+                        "write-ahead journal for this run", self.path, e)
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+            self._buf.clear()
+
+    def _write_loop(self) -> None:
+        while not self._closed:
+            self._wake.wait(self.flush_interval_s)
+            with self._io:
+                if self._fh is None:
+                    return
+                self._drain_locked()
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()  # let the writer exit promptly
+        with self._io:
+            self._drain_locked()
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError as e:
+                    log.warning("journal %s close failed: %s",
+                                self.path, e)
+                self._fh = None
+
+
+def journal_path(test) -> str:
+    return path(test, "journal.jsonl")
+
+
+def open_journal(test) -> Journal | None:
+    """A Journal in the test's store directory, or None when the test
+    has no prepared store identity (interpreter-only runs without a
+    name/start-time journal nowhere rather than littering ./store)."""
+    if not (test.get("name") and test.get("start-time")):
+        return None
+    j = Journal(make_path(test, "journal.jsonl"))
+    # a run killed before save_1 should still be `latest` for salvage
+    update_symlinks(test)
+    return j
+
+
+def read_journal(p: str) -> History:
+    """Replay a journal into a History, tolerating a torn final line (a
+    crash can land mid-write; the readable prefix is still a checkable
+    history). Corruption anywhere *before* the final line is real
+    damage, not a torn write, and raises ValueError."""
+    with open(p) as fh:
+        lines = fh.read().split("\n")
+    ops: list = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            ops.append(json.loads(line))
+        except ValueError as e:
+            if any(rest.strip() for rest in lines[i + 1:]):
+                raise ValueError(
+                    f"{p}: corrupt journal line {i + 1} "
+                    f"(not the final line): {e}") from e
+            break  # torn final line: keep the prefix
+    return history(ops)
+
+
+def load_journal(test) -> History | None:
+    """The journal-backed history for a test, or None if no journal was
+    ever written."""
+    p = journal_path(test)
+    if not os.path.exists(p):
+        return None
+    return read_journal(p)
 
 
 def write_results(test, results: dict) -> str:
@@ -250,15 +397,43 @@ def stop_logging() -> None:
 def load_test(d: str) -> dict:
     """Reconstruct a test map (with history and, when present, results)
     from a run directory — the post-hoc analysis path (reference
-    store/load, store.clj:193-250)."""
-    with open(os.path.join(d, "test.json")) as fh:
-        test = json.load(fh)
+    store/load, store.clj:193-250).
+
+    Salvage path: a run killed mid-history may have died before save_1,
+    leaving neither test.json nor history.jsonl.gz. The test identity
+    is then reconstructed from the <base>/<name>/<start-time> layout
+    and the history replayed from the write-ahead journal; such tests
+    carry 'salvaged-from-journal': True."""
+    # realpath, not normpath: callers pass the `latest` symlink, and the
+    # salvage fallback below reads name/start-time out of the path
+    d = os.path.realpath(d)
+    tj = os.path.join(d, "test.json")
+    have_test_json = os.path.exists(tj)
+    if have_test_json:
+        with open(tj) as fh:
+            test = json.load(fh)
+    else:
+        test = {"name": os.path.basename(os.path.dirname(d)),
+                "start-time": os.path.basename(d)}
     hist_path = os.path.join(d, "history.jsonl.gz")
     if os.path.exists(hist_path):
         # save_1 runs pre-analysis, so the stored history carries no
         # 'index' fields; index here so index-dependent consumers
         # (timeline anchors, linearizability reports) work post-hoc
         test["history"] = read_history(hist_path).index()
+    else:
+        jp = os.path.join(d, "journal.jsonl")
+        if os.path.exists(jp):
+            log.warning("%s: no history.jsonl.gz; salvaging history "
+                        "from the write-ahead journal", d)
+            test["history"] = read_journal(jp).index()
+            test["salvaged-from-journal"] = True
+        elif not have_test_json:
+            # nothing to reconstruct from — fail clearly instead of
+            # fabricating an identity for a wrong/empty directory
+            raise FileNotFoundError(
+                f"{d}: no test.json, history.jsonl.gz, or journal.jsonl"
+                " — not a test run directory")
     res_path = os.path.join(d, "results.json")
     if os.path.exists(res_path):
         with open(res_path) as fh:

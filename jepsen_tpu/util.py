@@ -108,13 +108,35 @@ class Timeout(Exception):
     pass
 
 
+class _TimedOut:
+    """Sentinel type for TIMED_OUT; falsy so guards read naturally."""
+
+    def __repr__(self):
+        return "<util.TIMED_OUT>"
+
+    def __bool__(self):
+        return False
+
+
+# Distinct from anything a wrapped fn could return: pass
+# ``default=TIMED_OUT`` to timeout() and compare with ``is``.
+TIMED_OUT = _TimedOut()
+
+
 def timeout(seconds: float, fn: Callable[[], Any],
             default: Any = Timeout) -> Any:
-    """Run fn in a worker thread; if it exceeds the deadline return
-    ``default`` (or raise Timeout when no default given). The worker is
-    abandoned, not killed — Python threads can't be interrupted safely, so
-    fns should be side-effect-tolerant (reference timeout interrupts,
-    util.clj:370; this is the closest portable semantic)."""
+    """Run fn in a daemon worker thread; if it exceeds the deadline
+    return ``default`` (or raise Timeout when no default is given).
+
+    The reference's `timeout` (util.clj:370) *interrupts* its thread;
+    Python threads cannot be interrupted, so the worker here is
+    **abandoned**, not killed: fn keeps running in the background until
+    it finishes on its own, and its late return value (or late
+    exception) is discarded — it is never delivered to any caller. fns
+    must therefore tolerate running to completion after their caller
+    has moved on (idempotent teardown, no half-owned locks). Pass
+    ``default=TIMED_OUT`` to get a sentinel distinct from anything fn
+    itself could return."""
     box: list = []
 
     def run():

@@ -31,6 +31,48 @@ def test_check_safe_catches():
     assert r["valid?"] == c.UNKNOWN and "kaboom" in r["error"]
 
 
+def test_check_safe_names_the_failing_checker():
+    def exploding_checker(test, hist, opts):
+        raise ValueError("kaboom")
+    r = c.check_safe(exploding_checker, {}, History([]), {})
+    assert r["checker"] == "exploding_checker"
+    assert "degraded" not in r  # a ValueError isn't a backend failure
+
+    class Exploding(c.Checker):
+        def check(self, test, hist, opts):
+            raise ValueError("kaboom")
+
+    r = c.check_safe(Exploding(), {}, History([]), {})
+    assert r["checker"] == "Exploding"
+    # an explicit name (what compose passes) wins
+    r = c.check_safe(Exploding(), {}, History([]), {}, name="linear")
+    assert r["checker"] == "linear"
+
+
+def test_check_safe_backend_runtime_error_reports_degraded():
+    """XLA/device failures surface as RuntimeError subclasses from jax;
+    they mean the device path fell over, not that the history has
+    anomalies — reported as 'degraded' so operators can tell the two
+    apart."""
+    def device_init_fails(test, hist, opts):
+        raise RuntimeError("INTERNAL: failed to initialize TPU system")
+    r = c.check_safe(device_init_fails, {}, History([]), {})
+    assert r["valid?"] == c.UNKNOWN
+    assert r["degraded"] is True
+    assert r["checker"] == "device_init_fails"
+    assert "initialize TPU" in r["error"]
+
+
+def test_compose_attributes_failures_per_checker():
+    def bad(test, hist, opts):
+        raise ValueError("which checker was it?")
+    good = lambda t, h, o: {"valid?": True}          # noqa: E731
+    r = c.compose({"fine": good, "broken": bad})({}, History([]))
+    assert r["valid?"] == c.UNKNOWN
+    assert r["broken"]["checker"] == "broken"
+    assert r["fine"]["valid?"] is True
+
+
 def test_compose():
     good = lambda t, h, o: {"valid?": True}          # noqa: E731
     bad = lambda t, h, o: {"valid?": False}          # noqa: E731

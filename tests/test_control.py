@@ -213,10 +213,74 @@ class TestRetryRemote:
 
         d = Dead()
         d.connect = lambda spec: d
-        r = retry.RetryRemote(d, retries=2, backoff_s=0.001).connect(
+        r = retry.RetryRemote(d, retries=2, backoff_s=0.001,
+                              backoff_cap_s=0.002).connect(
             {"host": "n1"})
         with pytest.raises(RemoteError, match="3 attempts"):
             r.execute({}, {"cmd": "ls"})
+
+
+class TestBackoff:
+    """Capped exponential backoff with decorrelated jitter: N workers
+    reconnecting through a healed partition must not retry in
+    lockstep."""
+
+    def test_schedule_bounded_by_base_and_cap(self):
+        import itertools
+        import random
+        ds = list(itertools.islice(
+            retry.backoff(0.1, 2.0, random.Random(1)), 50))
+        assert ds[0] == 0.1  # first delay is the base
+        assert all(0.1 <= d <= 2.0 for d in ds)
+        assert max(ds) == 2.0  # the cap is reached, never exceeded
+
+    def test_schedule_grows_from_base(self):
+        import itertools
+        import random
+        ds = list(itertools.islice(
+            retry.backoff(0.1, 2.0, random.Random(7)), 30))
+        # exponential-ish: the tail is well above the base on average
+        assert sum(ds[10:]) / len(ds[10:]) > 3 * 0.1
+
+    def test_schedules_decorrelate(self):
+        """Two workers with different rng streams must not share a
+        schedule — that's the whole point of the jitter."""
+        import itertools
+        import random
+        a = list(itertools.islice(
+            retry.backoff(0.1, 2.0, random.Random(1)), 20))
+        b = list(itertools.islice(
+            retry.backoff(0.1, 2.0, random.Random(2)), 20))
+        assert a[1:] != b[1:]
+
+    def test_deterministic_under_seed(self):
+        import itertools
+        import random
+        a = list(itertools.islice(
+            retry.backoff(0.05, 1.0, random.Random(3)), 10))
+        b = list(itertools.islice(
+            retry.backoff(0.05, 1.0, random.Random(3)), 10))
+        assert a == b
+
+    def test_nonzero_exit_still_not_retried_with_backoff_config(self):
+        """The no-retry-on-nonzero-exit invariant is independent of the
+        backoff schedule: a real command result propagates on attempt
+        one, whatever the delays would have been."""
+        import random
+        calls = {"n": 0}
+
+        class Failing(dummy.DummyRemote):
+            def execute(self, context, action):
+                calls["n"] += 1
+                raise RemoteError("bad", {"exit": 7})
+
+        f = Failing()
+        f.connect = lambda spec: f
+        r = retry.RetryRemote(f, backoff_s=0.5, backoff_cap_s=10.0,
+                              rng=random.Random(1)).connect({"host": "n1"})
+        with pytest.raises(RemoteError):
+            r.execute({}, {"cmd": "false"})
+        assert calls["n"] == 1
 
 
 class TestControlUtil:

@@ -39,6 +39,37 @@ def test_timeout():
         util.timeout(0.05, lambda: time.sleep(1))
 
 
+def test_timeout_sentinel_and_late_return_discarded():
+    """TIMED_OUT is distinct from anything fn could return, and the
+    abandoned worker's late return value is discarded — never delivered
+    to any caller (Python threads can't be interrupted; the fn runs to
+    completion in the background)."""
+    import threading
+    done = threading.Event()
+
+    def late():
+        time.sleep(0.2)
+        done.set()
+        return "late-value"
+
+    r = util.timeout(0.05, late, default=util.TIMED_OUT)
+    assert r is util.TIMED_OUT
+    assert not util.TIMED_OUT  # falsy, so `if not result:` guards work
+    assert done.wait(5), "abandoned fn still runs to completion"
+    assert r is util.TIMED_OUT
+
+
+def test_timeout_late_exception_discarded():
+    def late_boom():
+        time.sleep(0.1)
+        raise RuntimeError("too late")
+
+    assert util.timeout(0.02, late_boom,
+                        default=util.TIMED_OUT) is util.TIMED_OUT
+    # the late exception must not surface anywhere
+    time.sleep(0.2)
+
+
 def test_await_fn():
     state = {"n": 0}
 
