@@ -278,6 +278,90 @@ def section_adversarial():
     }}
 
 
+def section_streaming():
+    """Online verification tail latency vs offline full-check on the
+    10k adversarial shape, plus the early-abort demonstration on an
+    injected-violation history (checker/streaming.py).
+
+    Offline, analyze pays the FULL check after the run; online, the
+    device search advances while ops arrive and finalize() only pays
+    the unchecked tail — the number that matters is stream_tail_s
+    against offline_s. The feed loop here pushes ops as fast as the
+    pipeline accepts them (a worst case: a real run's op arrival is
+    slower, hiding even more of the device time)."""
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.streaming import WglStream
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
+    adv = synth.adversarial_register_history(
+        N_OPS, concurrency=6, crashed_writes=8, front_load=True,
+        seed=45100)
+    analysis_tpu(model, adv, budget_s=420)   # compile
+    t0 = time.monotonic()
+    off = analysis_tpu(model, adv, budget_s=420)
+    offline_s = time.monotonic() - t0
+    assert off["valid?"] is True, f"adversarial must verify: {off}"
+
+    # chunk size scales with the history so smoke-scale runs still
+    # exercise multi-chunk pipelining (~8 chunks); real 10k runs use
+    # the default 1024
+    chunk = max(64, min(1024, N_OPS // 8))
+
+    # dense streaming: the register's state range is declared up front
+    # (initial NIL=-1, written values 0..4) so the exact reachable-set
+    # table exists before the first op arrives
+    def stream_once():
+        s = WglStream(model, chunk_entries=chunk, engine="dense",
+                      state_range=(-1, 4), concurrency_hint=12)
+        t_feed = time.monotonic()
+        for op in adv.ops:
+            s.feed(op)
+        feed_s = time.monotonic() - t_feed
+        t_tail = time.monotonic()
+        r = s.finish()
+        return r, feed_s, time.monotonic() - t_tail
+
+    stream_once()                            # compile
+    r, feed_s, tail_s = stream_once()
+    assert r["valid?"] is True, f"stream verdict diverged: {r}"
+
+    # early abort: a violation injected mid-history is detected while
+    # ops are still arriving; the remaining run time would be saved
+    plain = synth.register_history(N_OPS, concurrency=CONCURRENCY,
+                                   values=5, crash_rate=0.0, seed=45100)
+    bad = synth.corrupt(plain, seed=11)
+    bad_at = next(i for i, (a, b) in enumerate(zip(plain.ops, bad.ops))
+                  if a != b)
+    s = WglStream(model, chunk_entries=chunk,
+                  concurrency_hint=CONCURRENCY)
+    fed = 0
+    for op in bad.ops:
+        s.feed(op)
+        fed += 1
+        if s.violation:
+            break
+    rb = s.finish()
+    assert rb["valid?"] is False, f"violation must be caught: {rb}"
+    return {"streaming": {
+        "shape": "adversarial 10k (conc 6, 8 crashed writes, "
+                 "front-loaded), dense engine",
+        "offline_s": round(offline_s, 3),
+        "stream_feed_s": round(feed_s, 3),
+        "stream_tail_s": round(tail_s, 3),
+        "tail_vs_offline_speedup": round(offline_s / max(tail_s, 1e-4),
+                                         1),
+        "chunks": r["chunks"],
+        "verdict": str(r["valid?"]),
+        "early_abort": {
+            "violation_injected_at_op": bad_at,
+            "detected_after_ops_fed": fed,
+            "total_history_ops": len(bad.ops),
+            "run_fraction_saved": round(1 - fed / len(bad.ops), 3),
+            "verdict": str(rb["valid?"]),
+        }}}
+
+
 def section_config1():
     """Tutorial-scale 200-op register (CPU parity target)."""
     from jepsen_tpu.checker import synth
@@ -477,6 +561,7 @@ def section_generator():
 SECTIONS = [
     ("headline", section_headline, 900, True),
     ("adversarial", section_adversarial, 600 + HOST_BUDGET_S, True),
+    ("streaming", section_streaming, 900, True),
     ("config1", section_config1, 420, True),
     ("config2", section_config2, 480, True),
     ("config3", section_config3, 600, True),
@@ -697,7 +782,7 @@ def main() -> int:
             headline = payload
             extra["wgl_best_s"] = payload["wgl_best_s"]
             extra["wgl_engine"] = payload["wgl_engine"]
-        elif name == "adversarial":
+        elif name in ("adversarial", "streaming"):
             extra.update(payload)
         elif name.startswith("config") or name == "addgraphs":
             configs.update(payload)
@@ -724,6 +809,14 @@ def main() -> int:
         out["error"] = "partial: " + ", ".join(
             n for n, m in sections_meta.items() if "error" in m)
     print(json.dumps(out))
+    # A missing backend is an environment condition, not a bench
+    # failure: the host-only JSON line above is the complete, parseable
+    # result for such a round (BENCH_r05 recorded rc 1 + parsed null
+    # because drivers treat nonzero exit as "no result"). Exit 0 so the
+    # host numbers land; the "error" field still says the WGL numbers
+    # are absent. Genuinely partial healthy-backend runs stay rc 1.
+    if degraded:
+        return 0
     return 0 if "error" not in out else 1
 
 

@@ -24,3 +24,22 @@ def honor_platform_env() -> None:
 
 # historical name, used by earlier entry scripts
 honor_cpu_env = honor_platform_env
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at a stable directory
+    (bench.py has always done this for its per-section subprocesses;
+    this is the same lever for the CLI runner, so repeat `test` /
+    `analyze` invocations skip recompiling the checker kernels).
+
+    Env-gated: JEPSEN_TPU_COMPILE_CACHE=0 disables entirely; an
+    existing JAX_COMPILATION_CACHE_DIR always wins (we only ever
+    setdefault). Returns the directory in effect, or None when
+    disabled. Safe to call before or after jax import — JAX reads the
+    env var lazily at first compile."""
+    if os.environ.get("JEPSEN_TPU_COMPILE_CACHE") == "0":
+        return None
+    d = cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "jepsen-tpu", "jax")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]

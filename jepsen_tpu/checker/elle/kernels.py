@@ -30,11 +30,16 @@ rw-edge probe; see `_classify_oversized`.
 
 from __future__ import annotations
 
+import collections
 import functools
 import math
 import os
 
 import numpy as np
+
+# cap on the per-analysis G2 probe memo (see g2_verified): bounds the
+# memo in long-lived checker processes chewing pathological histories
+G2_CACHE_CAP = 4096
 
 _WW, _WR, _RW = 1, 2, 4
 # additional precedence graphs (graphs.py): realtime (completion
@@ -486,8 +491,13 @@ def analyze_edges(n: int, edges: dict, mesh=None,
 
     # per-SCC G2 probes, memoized by (label, level): the dense
     # distinct-rw-sources test over-approximates, so each flagged SCC is
-    # host-verified with the stricter simple-path probe
-    _g2_cache: dict[tuple, bool] = {}
+    # host-verified with the stricter simple-path probe. LRU with a
+    # size cap: a pathological history can flag thousands of SCCs
+    # across several levels, and an uncapped memo would hold every
+    # probe result for the whole call — evicting the oldest entries
+    # only costs a re-probe if the same (label, level) is asked again.
+    _g2_cache: "collections.OrderedDict[tuple, bool]" = \
+        collections.OrderedDict()
 
     def g2_verified(lab: int, li: int) -> bool:
         key = (lab, li)
@@ -497,6 +507,10 @@ def analyze_edges(n: int, edges: dict, mesh=None,
             got = _probe_g2(*_fold_level(
                 e_src[emask], e_dst[emask], e_t[emask], levels[li][1]))
             _g2_cache[key] = got
+            if len(_g2_cache) > G2_CACHE_CAP:
+                _g2_cache.popitem(last=False)
+        else:
+            _g2_cache.move_to_end(key)
         return got
 
     def combine(per_level: list) -> None:

@@ -260,6 +260,16 @@ class Linearizable(Checker):
         self.opts = opts
 
     def check(self, test, hist, opts):
+        streamed = self._streamed_result(test, hist)
+        if streamed is not None:
+            # same post-processing as an offline verdict: a definite
+            # invalid still renders its linear.svg failure neighborhood
+            try:
+                from .explain import write_failure_svg
+                write_failure_svg(test or {}, opts, streamed, hist)
+            except OSError:
+                pass
+            return streamed
         algo = self.algorithm
         if algo in ("linear", "wgl"):
             algo = "auto"
@@ -298,6 +308,27 @@ class Linearizable(Checker):
         except OSError:  # unwritable store is not a checking failure
             pass
         return a
+
+    def _streamed_result(self, test, hist) -> dict | None:
+        """A verdict already produced by the online pipeline
+        (core.run stashes it under test['streamed-results']) — reuse
+        it instead of re-searching the same history, but only when it
+        is definite and demonstrably covers this history (same client
+        op count; post-hoc `analyze` may be handed a different one)
+        AND this checker's model (a Compose can hold several
+        Linearizable checkers — only the one whose model was streamed
+        may reuse the verdict). An 'unknown' streamed verdict
+        (frontier cap) re-checks offline, where the dense engine or
+        host fallback may still decide it."""
+        r = ((test or {}).get("streamed-results") or {}).get("linear")
+        if not r or r.get("valid?") not in (True, False):
+            return None
+        if r.get("model") != repr(self.model):
+            return None
+        if r.get("history-len") != \
+                len(as_history(hist).client_ops()):
+            return None
+        return _truncate(dict(r))
 
     def _compete(self, hist) -> dict:
         """Race the host search against the device kernel; first

@@ -475,7 +475,7 @@ def _bucket(n: int, lo: int = 64) -> int:
 
 Kernel = collections.namedtuple(
     "Kernel", ["check", "check_batch", "check_chunk", "check_chunk_batch",
-               "init_carry", "summarize"])
+               "check_stream_chunk", "init_carry", "summarize"])
 
 
 def _pack_params(state_range: tuple[int, int] | None,
@@ -719,8 +719,19 @@ def _kernel(model_name: str, F: int, P: int, E: int,
     def check_chunk_batch(x, stops, carry):
         return jax.vmap(run_range)(x, stops, carry)
 
+    @jax.jit
+    def check_stream_chunk(x, n, carry):
+        # Streaming entry: x holds only THIS chunk's steps, so the
+        # carry's absolute event count is rebased to 0 for the range
+        # walk and restored afterwards — a growing history streams as
+        # fixed-shape chunks through ONE compiled kernel, shipping each
+        # step exactly once (the whole-x chunk API re-ships the prefix).
+        local = (i32(0),) + tuple(carry[1:])
+        out = run_range(x, n, local)
+        return (out[0] + carry[0],) + tuple(out[1:])
+
     return Kernel(check, check_batch, check_chunk, check_chunk_batch,
-                  init_carry, summarize)
+                  check_stream_chunk, init_carry, summarize)
 
 
 # ---------------------------------------------------------------------------
@@ -944,8 +955,15 @@ def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
     def check_chunk_batch(x, stops, carry):
         return jax.vmap(run_range)(x, stops, carry)
 
+    @jax.jit
+    def check_stream_chunk(x, n, carry):
+        # streaming rebase — see the sort kernel's twin for the contract
+        local = (i32(0),) + tuple(carry[1:])
+        out = run_range(x, n, local)
+        return (out[0] + carry[0],) + tuple(out[1:])
+
     return Kernel(check, check_batch, check_chunk, check_chunk_batch,
-                  init_carry, summarize)
+                  check_stream_chunk, init_carry, summarize)
 
 
 DENSE_STATE_CAP = 512  # closure() is O(P * S^2 * C): bound S too

@@ -101,6 +101,15 @@ def test_opt_spec() -> list[dict]:
                  "teardown, in seconds"),
         opt("--store-dir", default="store", metavar="DIR",
             help="Directory to store test results under"),
+        opt("--online", action="store_true",
+            help="Verify the history online: a streaming checker "
+                 "tails the run's journal and advances the device "
+                 "search while the run executes, so analysis latency "
+                 "collapses to the unchecked tail."),
+        opt("--abort-on-violation", action="store_true",
+            help="With --online: abort the run as soon as the "
+                 "streaming checker confirms a nonlinearizable "
+                 "prefix, saving the remaining cluster time."),
     ]
 
 
@@ -269,6 +278,24 @@ def _resolve_opt_fn(opts: dict):
     return opts.get("opt_fn_") or opt_fn
 
 
+def _enable_compile_cache(options: dict) -> None:
+    """Persistent JAX compilation cache for the CLI runner, under the
+    run's store directory (bench.py has used the same lever for its
+    per-section subprocesses since r05: the cache is what keeps repeat
+    invocations from re-paying every kernel compile). Env-gated via
+    JEPSEN_TPU_COMPILE_CACHE=0 / an explicit JAX_COMPILATION_CACHE_DIR
+    — see _platform.enable_compilation_cache."""
+    import os
+
+    from ._platform import enable_compilation_cache
+
+    store_dir = options.get("store-dir") or options.get("store_dir")
+    d = enable_compilation_cache(
+        os.path.join(store_dir, ".jax_cache") if store_dir else None)
+    if d:
+        log.info("JAX persistent compilation cache: %s", d)
+
+
 def single_test_cmd(opts: dict) -> dict:
     """Builds the `test` and `analyze` commands around a test_fn
     (`cli.clj:355-430`). Options: opt_spec (extra spec entries),
@@ -285,6 +312,7 @@ def single_test_cmd(opts: dict) -> dict:
 
     def run_test(options):
         log.info("Test options:\n%s", _pprint.pformat(options))
+        _enable_compile_cache(options)
         # test_count fallback: an opt_fn_ override replaces the pipeline
         # that remaps argparse's test_count to test-count
         for _ in range(options.get("test-count",
@@ -298,6 +326,7 @@ def single_test_cmd(opts: dict) -> dict:
     def run_analyze(options):
         from . import store
         log.info("Test options:\n%s", _pprint.pformat(options))
+        _enable_compile_cache(options)
         cli_test = test_fn(options)
         latest = store.latest(cli_test.get("store-dir", "store"))
         if latest is None:

@@ -7,11 +7,16 @@ plain dict; `run(test)` takes it through the full lifecycle:
 1. set up the operating system on every node,
 2. teardown-then-setup the database (with primary setup if supported),
 3. set up the nemesis and one client per node,
-4. drive the generator through the interpreter, journaling a history,
+4. drive the generator through the interpreter, journaling a history
+   (with test['online'], a streaming checker tails the journal and
+   advances the device search *during* the run; with
+   test['abort-on-violation'] a confirmed nonlinearizable prefix
+   stops the run early),
 5. capture DB log files,
 6. tear down database and OS,
 7. index the history and run the checker — on TPU for the offloaded
-   checkers — writing results to the store.
+   checkers; a result already streamed online is reused instead of
+   re-checked — writing results to the store.
 
 The run survives its own faults the way the reference does: resources
 started in parallel are unwound on partial failure (`with-resources`,
@@ -421,21 +426,48 @@ def run(test: dict) -> dict:
     with with_logging(test):
         with with_sessions(test) as stest:
             with with_os(stest), with_db(stest):
+                oc = _maybe_online(stest)
+                if oc is not None:
+                    stest = {**stest, "online-checker": oc}
                 with util.relative_time():
                     try:
                         hist = run_case(stest)
                     except BaseException:
                         # the journal-backed prefix is still written
                         # even when the run itself dies
+                        if oc is not None:
+                            oc.close()
                         _salvage_journal(stest)
                         raise
                 # strip run-state the analysis/persistence layers must
                 # not see (reference dissoc, core.clj:393-395)
                 done = {k: v for k, v in stest.items()
-                        if k not in ("barrier", "sessions")}
+                        if k not in ("barrier", "sessions",
+                                     "online-checker")}
                 done["history"] = hist
+                if oc is not None:
+                    streamed = oc.finalize()
+                    if streamed:
+                        done["streamed-results"] = streamed
+                        log.info("Online verification finished %s "
+                                 "during the run", sorted(streamed))
+                    if oc.aborted:
+                        done["aborted-on-violation"] = True
                 log.info("Run complete, writing")
                 if done.get("name"):
                     store.save_1(done)
             done = analyze(done)
         return log_results(done)
+
+
+def _maybe_online(test: dict):
+    """The streaming/online checker for a test that asked for one, or
+    None — never raises: online checking is an optimization and its
+    setup failing must not kill the run."""
+    try:
+        from .checker import streaming
+        return streaming.maybe_online(test)
+    except Exception:  # noqa: BLE001
+        log.warning("online verification setup failed; running "
+                    "offline only", exc_info=True)
+        return None

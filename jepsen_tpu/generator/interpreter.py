@@ -28,6 +28,15 @@ Run survivability (beyond the reference):
     wedged worker thread is abandoned and replaced, and the late real
     completion — should the abandoned worker ever answer — is
     discarded. A hung client can therefore never wedge the run.
+
+Online verification (checker/streaming.py): when the test carries an
+'online-checker', every history op is offered to it live — via the
+journal's subscribe feed when a journal exists (op flow rides the WAL
+append), directly from the recording hook otherwise — and the
+scheduler polls should_abort() before asking the generator for more
+work: a confirmed mid-run violation stops new ops, drains the
+outstanding ones (op-timeouts still bound them), and returns the
+history checked so far, saving the rest of the cluster time.
 """
 
 from __future__ import annotations
@@ -228,11 +237,23 @@ def run(test: dict) -> History:
     deadlines: dict = {}
     op_timeout = test.get("op-timeout")
     journal = store.open_journal(test)
+    online = test.get("online-checker")
+    hook = None
+    if online is not None:
+        if journal is not None:
+            # live ops ride the WAL append path (Journal.subscribe) —
+            # one feed, shared with the crash-survivability journal
+            journal.subscribe(online.offer)
+        else:
+            hook = online.offer
+    aborted = False
 
     def record(o: dict) -> None:
         history.append(o)
         if journal is not None:
             journal.append(o)
+        elif hook is not None:
+            hook(o)
 
     def deadline_capped(us: int, now: int) -> int:
         # never sleep past the nearest in-flight deadline
@@ -339,7 +360,14 @@ def run(test: dict) -> History:
 
             now = relative_time_nanos()
             ctx = ctx.with_time(now)
-            res = gen_op(gen, test, ctx)
+            if online is not None and not aborted \
+                    and online.should_abort():
+                aborted = True
+                LOG.warning(
+                    "online checker confirmed a violation; aborting "
+                    "the run early (%d ops outstanding will drain)",
+                    outstanding)
+            res = None if aborted else gen_op(gen, test, ctx)
             if res is None:
                 if outstanding > 0:
                     poll_timeout_us = MAX_PENDING_INTERVAL_US

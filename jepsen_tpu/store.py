@@ -16,7 +16,7 @@ import json
 import logging
 import os
 import threading as _threading
-from typing import Any, Iterable
+from typing import Any, Callable, Iterable
 
 from .history import INFO, NEMESIS, History, history
 
@@ -139,13 +139,41 @@ class Journal:
         self._io = _threading.Lock()
         self._closed = False
         self._wake = _threading.Event()
+        self._subs: list = []
         self._writer = _threading.Thread(
             target=self._write_loop, name="jepsen-journal", daemon=True)
         self._writer.start()
 
+    def subscribe(self, fn) -> "Callable[[], None]":
+        """Register fn(op), called synchronously with every appended op
+        (the live feed for online/streaming checkers — no disk
+        round-trip, no flush-interval lag). fn runs on the appending
+        thread (the interpreter's scheduler), so it must be cheap: a
+        queue push, not a device dispatch. A subscriber that raises is
+        dropped, loudly — a broken consumer must never abort the run.
+        Returns an unsubscribe thunk."""
+        self._subs.append(fn)
+
+        def unsubscribe() -> None:
+            try:
+                self._subs.remove(fn)
+            except ValueError:
+                pass
+        return unsubscribe
+
     def append(self, op: dict) -> None:
         if self._closed:
             return
+        for fn in list(self._subs):
+            try:
+                fn(op)
+            except Exception:  # noqa: BLE001 — see subscribe()
+                log.warning("journal subscriber %r failed; dropping it",
+                            fn, exc_info=True)
+                try:
+                    self._subs.remove(fn)
+                except ValueError:
+                    pass
         self._buf.append(op)
         if op.get("type") == INFO or op.get("process") == NEMESIS:
             self.flush()
@@ -239,6 +267,48 @@ def read_journal(p: str) -> History:
                     f"(not the final line): {e}") from e
             break  # torn final line: keep the prefix
     return history(ops)
+
+
+class JournalTail:
+    """Tail-follow reader of a journal.jsonl another thread/process is
+    still appending to — the out-of-process feed for online checking
+    (the in-process feed is Journal.subscribe). poll() returns the ops
+    whose lines have *completely* landed since the last poll; a torn
+    trailing line (the writer mid-write, or mid-OS-flush) is buffered
+    until the rest of it arrives, so a consumer polling a live journal
+    never sees a parse error for an op that is still being written. A
+    corrupt line that HAS been completed (newline present) is real
+    damage and raises ValueError, mirroring read_journal."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._buf = ""
+
+    def poll(self) -> list[dict]:
+        try:
+            with open(self.path) as fh:
+                fh.seek(self._pos)
+                data = fh.read()
+                self._pos = fh.tell()
+        except FileNotFoundError:
+            return []
+        if not data:
+            return []
+        self._buf += data
+        lines = self._buf.split("\n")
+        self._buf = lines.pop()   # incomplete tail (or "")
+        out = []
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(
+                    f"{self.path}: corrupt journal line (newline-"
+                    f"terminated, so not a torn tail): {e}") from e
+        return out
 
 
 def load_journal(test) -> History | None:

@@ -45,7 +45,10 @@ def test_degraded_mode_reports_host_numbers():
     # an unknown platform makes the preflight probe fail fast and
     # deterministically — the orchestrator must degrade, not crash
     rc, out = _run_bench({"JAX_PLATFORMS": "no-such-platform"})
-    assert rc == 1
+    # a missing backend exits 0: the host-only JSON line IS the round's
+    # result (rc 1 made drivers discard it — BENCH_r05's rc:1 +
+    # parsed:null); the "error" field still marks the WGL numbers absent
+    assert rc == 0
     assert out["error"] == "tpu-backend-unavailable"
     assert out["value"] is None
     assert "preflight" in out["extra"] and "backend" not in out["extra"]
