@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test check
+.PHONY: lint test tier1 check
 
 lint:
 	$(PY) tools/lint.py
@@ -12,5 +12,10 @@ lint:
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# the driver's tier-1 gate: everything not marked slow (the slow tier
+# holds the larger shape sweeps, e.g. the pallas dedup parity sweep)
+tier1:
+	$(PY) -m pytest tests/ -q -m 'not slow'
 
 check: lint test

@@ -247,9 +247,28 @@ class Linearizable(Checker):
     Extra keyword options flow straight to the device engine
     (`wgl.analysis_tpu`), so the search heuristics are user-tunable the
     way knossos's memoization threshold should have been (its plan.md
-    asks for this): `engine='auto'|'dense'|'sort'`, `frontier`,
-    `max_frontier`, `chunk_entries`, `budget_s`, e.g.
-    ``linearizable({'model': m, 'engine': 'dense', 'budget_s': 120})``.
+    asks for this):
+
+      engine='auto'|'dense'|'sort' — kernel family; 'auto' runs the
+                     cost model (`wgl.select_engine`: state-range
+                     width, slot count, history length, frontier)
+      dense_slot_cap int — 'auto' never asks the dense table to absorb
+                     more than this many slots (each slot doubles the
+                     table; cap it when tail concurrency is known)
+      pallas=True|False|None — force the Pallas kernel variants (dense
+                     closure round, sort-family hash dedup) on/off;
+                     None defers to the JEPSEN_TPU_PALLAS_* env gates
+                     (default ON on real TPU backends)
+      frontier / max_frontier / chunk_entries / budget_s — the sort
+                     family's frontier sizing, escalation cap, device
+                     call granularity, and wall-clock budget
+
+    e.g. ``linearizable({'model': m, 'engine': 'dense',
+    'budget_s': 120})`` or ``linearizable(m, dense_slot_cap=12,
+    pallas=True)``. Of these, only `pallas` reaches the online
+    pipeline (checker/streaming.py picks its own engine from the
+    test's declared `online-state-range`); the rest apply when the
+    history is checked offline.
     """
 
     def __init__(self, model: m.Model, algorithm: str = "auto", **opts):

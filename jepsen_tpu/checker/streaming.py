@@ -260,12 +260,16 @@ class WglStream:
     finish() returns an analysis dict shaped like `wgl.analysis_tpu`'s
     (plus 'tail-latency-ms', 'chunks', 'streamed').
 
-    engine: 'sort' (default — works with no a-priori knowledge; config
-    packing is disabled because the state range is only known once the
-    run ends) or 'dense' (exact, no frontier, but needs `state_range`
-    declared up front so the reachable-set table can be allocated
-    before the first op arrives). Values escaping a declared dense
-    range trigger a transparent rebuild onto the sort kernel.
+    engine: 'sort' (default — works with no a-priori knowledge) or
+    'dense' (exact, no frontier, but needs `state_range` declared up
+    front so the reachable-set table can be allocated before the
+    first op arrives). A declared state_range also lets the SORT
+    family pack configs into single-u32 keys up front, which is what
+    makes the Pallas hash dedup (JEPSEN_TPU_PALLAS_DEDUP /
+    pallas=True) available online — without it the sort stream keeps
+    the multi-word lexicographic dedup. Values escaping a declared
+    range trigger a transparent rebuild: dense -> sort, packed sort
+    -> unpacked sort.
 
     NOTE the carry round-trip caveat from wgl.run_range: the carry is
     checkpointable through host memory, but the streaming path never
@@ -278,7 +282,8 @@ class WglStream:
                  chunk_entries: int = DEFAULT_CHUNK_ENTRIES,
                  engine: str = "sort",
                  state_range: tuple[int, int] | None = None,
-                 concurrency_hint: int | None = None):
+                 concurrency_hint: int | None = None,
+                 pallas=None):
         name = model.device_model
         if name is None or name not in _wgl.DEVICE_MODELS:
             raise ValueError(f"model {model!r} has no device form")
@@ -291,10 +296,16 @@ class WglStream:
         if engine not in ("sort", "dense", "auto"):
             raise ValueError(f"unknown streaming engine {engine!r}")
         self.state_range = state_range
+        self.pallas = pallas
         self.engine = self._pick_engine(engine, state_range)
         p0 = slots or _wgl._bucket(
             max(int(concurrency_hint or 0) + 4, 8), lo=8)
         self.p = p0
+        # a declared state range lets the sort family pack configs up
+        # front (the offline path derives this from the whole history;
+        # online it must be promised) — range escapes drop it below
+        self._pack = (_wgl._pack_params(state_range, p0)
+                      if state_range is not None else None)
         if self.engine == "dense":
             # validate at construction, not at first dispatch deep
             # inside feed(): a forced 'dense' raises (the caller asked
@@ -353,10 +364,12 @@ class WglStream:
 
         if self.engine == "dense":
             lo, S, P = self._dense_shape()
-            self._k = _wgl._dense_kernel(self.name, lo, S, P, self.chunk)
+            self._k = _wgl._dense_kernel(self.name, lo, S, P,
+                                         self.chunk, pallas=self.pallas)
         else:
             self._k = _wgl._kernel(self.name, self.frontier, self.p,
-                                   self.chunk, None)
+                                   self.chunk, self._pack,
+                                   pallas=self.pallas)
         w = self.encoder.w
         pad = np.zeros((self.chunk, w + 4), np.int32)
         pad[:, w] = -1
@@ -422,6 +435,11 @@ class WglStream:
         log.info("online WGL stream rebuilding: slots %d -> %d "
                  "(engine %s)", self.p, p, self.engine)
         self.p = p
+        if self._pack is not None:
+            # the packed key budget shrinks as slots grow (P + state
+            # bits + 1 must fit 32) — recompute, dropping to the
+            # multi-word dedup when it no longer fits
+            self._pack = _wgl._pack_params(self.state_range, p)
         self.encoder = StreamEncoder(self.dm.codec, self.dm.droppable, p)
         self._k = None
         self._steps_log = []
@@ -441,15 +459,18 @@ class WglStream:
                 return
             rows = self.encoder.take(self.chunk)
             arr = np.asarray(rows, np.int32)
-            if self.engine == "dense" and self._range_escape(arr):
+            if (self.engine == "dense" or self._pack is not None) \
+                    and self._range_escape(arr):
                 # a value escaped the declared state range: the dense
                 # table would silently drop legal linearizations (an
-                # unsound 'invalid') — downgrade to the sort kernel
-                # and replay
+                # unsound 'invalid'), and a packed sort key would wrap
+                # into a neighboring config — downgrade to the
+                # unpacked sort kernel and replay
                 log.warning("online WGL stream: value outside the "
-                            "declared dense state range; rebuilding "
-                            "onto the sort kernel")
+                            "declared state range; rebuilding onto "
+                            "the unpacked sort kernel")
                 self.engine = "sort"
+                self._pack = None
                 self._rebuild(p=self.p)
                 return
             self._dispatch(arr)
@@ -569,7 +590,8 @@ class WglStream:
             # invalid under overflow: the witness may have been dropped
             # — replay everything at 4x the frontier (offline contract)
             F *= 4
-            k2 = _wgl._kernel(self.name, F, self.p, self.chunk, None)
+            k2 = _wgl._kernel(self.name, F, self.p, self.chunk,
+                              self._pack, pallas=self.pallas)
             carry = self._replay(all_steps, k2)
             ok, death, overflow, max_count = jax.device_get(
                 k2.summarize(carry))
@@ -582,6 +604,9 @@ class WglStream:
             "analyzer": ("tpu-wgl-dense-streaming"
                          if self.engine == "dense"
                          else "tpu-wgl-streaming"),
+            "dedup": (_wgl.DEDUP_NONE if self.engine == "dense" else
+                      _wgl.dedup_engine(F, self.p, self._pack,
+                                        self.pallas)),
             "op-count": len(ops),
             "max-frontier": int(max_count),
             "frontier-size": F,
@@ -1003,7 +1028,8 @@ def maybe_online(test: dict):
                             if test.get("online-state-range") else
                             "sort"),
                     state_range=test.get("online-state-range"),
-                    concurrency_hint=test.get("concurrency"))
+                    concurrency_hint=test.get("concurrency"),
+                    pallas=c.opts.get("pallas"))
             except (ValueError, ImportError) as e:
                 log.warning("online: linearizable target declined: %s",
                             e)

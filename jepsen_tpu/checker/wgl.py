@@ -493,9 +493,79 @@ def _pack_params(state_range: tuple[int, int] | None,
     return s_lo, sb_bits
 
 
-@functools.lru_cache(maxsize=32)
+def _pallas_enabled(env_var: str, override=None) -> tuple[bool, bool]:
+    """Resolve a pallas opt-in/out to (use_pallas, on_tpu): an explicit
+    checker option beats the env gate beats the backend default (ON for
+    real TPU, interpret-mode opt-in elsewhere). Resolved OUTSIDE the
+    kernel caches so flipping the env (or passing pallas=) mid-process
+    takes effect on the next call."""
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if override is not None:
+        return bool(override), on_tpu
+    flag = os.environ.get(env_var)
+    return (flag == "1" or (flag != "0" and on_tpu)), on_tpu
+
+
+# dedup-engine names (reported in analyses and bench artifacts)
+DEDUP_PALLAS = "pallas-hash"
+DEDUP_SORT = "xla-sort"
+DEDUP_NONE = "dense-table"   # the dense family has no dedup at all
+
+
+def _hash_gate(F: int, P: int, pack: tuple[int, int] | None,
+               on_tpu: bool) -> bool:
+    """The ONE gate for the Pallas hash dedup: single-u32 packed
+    config (pack resolved, one mask word), the hash working set in
+    VMEM, and — on a real TPU — a passing one-time Mosaic compile
+    probe (interpret mode is pure JAX and needs none). Shared by the
+    kernel build (_kernel_cached) and every reporting site
+    (dedup_engine), so the 'dedup' stamped in analyses can never
+    drift from the engine the kernel actually ran."""
+    if pack is None or (P + 31) // 32 > 1:
+        return False
+    from . import wgl_dedup
+    if not wgl_dedup.eligible(F, P):
+        return False
+    return wgl_dedup.compiles() if on_tpu else True
+
+
+def dedup_engine(F: int, P: int, pack: tuple[int, int] | None,
+                 pallas=None) -> str:
+    """Which dedup the sort-family kernel would run at this shape —
+    shapes failing _hash_gate keep the lexicographic sort."""
+    use, on_tpu = _pallas_enabled("JEPSEN_TPU_PALLAS_DEDUP", pallas)
+    return DEDUP_PALLAS if use and _hash_gate(F, P, pack, on_tpu) \
+        else DEDUP_SORT
+
+
 def _kernel(model_name: str, F: int, P: int, E: int,
-            pack: tuple[int, int] | None = None):
+            pack: tuple[int, int] | None = None, pallas=None):
+    """Build (or fetch) the jitted sort-family checker. The
+    Pallas-vs-XLA dedup choice is resolved HERE, outside the cache, so
+    flipping JEPSEN_TPU_PALLAS_DEDUP (or a checker's pallas= option)
+    mid-process takes effect on the next call instead of being baked
+    into a cached kernel — the same contract as _dense_kernel."""
+    use_dedup, on_tpu = _pallas_enabled("JEPSEN_TPU_PALLAS_DEDUP",
+                                        pallas)
+    return _kernel_cached(model_name, F, P, E, pack, use_dedup, on_tpu)
+
+
+def _clear_sort_caches():
+    """Reset every cache that baked in a sort-kernel build decision
+    (tests reach through the _kernel wrapper for this)."""
+    _kernel_cached.cache_clear()
+    _sharded_runner_cached.cache_clear()
+
+
+_kernel.cache_clear = _clear_sort_caches
+
+
+@functools.lru_cache(maxsize=32)
+def _kernel_cached(model_name: str, F: int, P: int, E: int,
+                   pack: tuple[int, int] | None,
+                   use_dedup: bool, on_tpu: bool):
     """Build the jitted checker for a (model, frontier-size, slots,
     entry-capacity) shape. Returns fn(entry arrays..., n_entries) ->
     (ok, death_entry, overflow, max_frontier).
@@ -504,7 +574,14 @@ def _kernel(model_name: str, F: int, P: int, E: int,
     (invalid flag, biased state, P-bit pending mask) fits one uint32,
     dedup packs it into a single sort key; the multi-word
     lexicographic sort is the kernel's dominant cost, so this is the
-    difference between sorting one u32 lane and W+2 lanes per entry."""
+    difference between sorting one u32 lane and W+2 lanes per entry.
+
+    use_dedup: with a packed config, route the dedup through the
+    Pallas open-addressing hash kernel (checker/wgl_dedup.py) instead
+    of the sort — same frontier *set* in first-seen order instead of
+    key order, so verdicts/summaries/blame are identical (the
+    downstream phases are order-invariant). Shapes the hash gate
+    rejects keep the sort."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -518,6 +595,15 @@ def _kernel(model_name: str, F: int, P: int, E: int,
     else:
         s_lo, sb_bits = 0, 64
     packed = pack is not None and W == 1
+
+    # Pallas hash dedup (the sort-free frontier): _hash_gate is sized
+    # for the kernel's LARGEST dedup call (stage B's F*(1+P)
+    # candidates) so one kernel never mixes dedup engines.
+    hash_dedup = None
+    if use_dedup and _hash_gate(F, P, pack, on_tpu):
+        from . import wgl_dedup
+        hash_dedup = functools.partial(
+            wgl_dedup.dedup_fn, F=F, interpret=not on_tpu)
 
     # per-slot bit-vector table, shared by the completion phase and the
     # expansion stage
@@ -548,6 +634,27 @@ def _kernel(model_name: str, F: int, P: int, E: int,
         new_f = valid_f & (org_s[:F] == 1)
         return masks_f, states_f, valid_f, new_f, valid_f.sum(), overflow
 
+    def dedup_hash(masks, states, valid):
+        """Sort-free dedup: the packed 31-bit config key goes through
+        the Pallas open-addressing hash kernel (wgl_dedup), which
+        returns the distinct valid keys compacted in first-seen order
+        plus per-slot new flags. Old configs occupy input rows [0, F)
+        at both call sites, so first-seen-wins is exactly the stable
+        sort's old-configs-first rule and `new` needs no origin lane.
+        The frontier is set-equal to the sort path's — downstream is
+        order-invariant, so verdicts/summaries/blame are identical."""
+        key = jnp.where(
+            valid,
+            ((states - s_lo) << P) | masks[:, 0].astype(i32),
+            i32(-1))
+        out_keys, new_f, distinct = hash_dedup(len(key))(key)
+        valid_f = out_keys >= 0
+        safe = jnp.where(valid_f, out_keys, 0)
+        masks_f = (safe & ((1 << P) - 1)).astype(u32)[:, None]
+        states_f = (safe >> P) + s_lo
+        return masks_f, states_f, valid_f, new_f & valid_f, \
+            valid_f.sum(), distinct > F
+
     def dedup(masks, states, valid, origin):
         """Sort (N,)-rows lexicographically by (invalid, mask words, state);
         mark duplicate keys invalid (stable sort + old-configs-first makes
@@ -555,6 +662,8 @@ def _kernel(model_name: str, F: int, P: int, E: int,
 
         Returns (masks[F,W], states[F], valid[F], new[F], count, overflow).
         """
+        if hash_dedup is not None:
+            return dedup_hash(masks, states, valid)
         if packed:
             return dedup_packed(masks, states, valid, origin)
         invalid_key = (~valid).astype(u32)
@@ -761,19 +870,17 @@ def _kernel(model_name: str, F: int, P: int, E: int,
 DENSE_TABLE_CAP = 1 << 22   # max S * 2^P bools held as the dense table
 
 
-def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int):
+def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int,
+                  pallas=None):
     """Build the jitted dense-table checker for S states x P slots x
     E entry capacity. Same call shapes as the sort kernel.
 
     The Pallas-vs-XLA closure choice is resolved HERE, outside the
-    cache, so flipping JEPSEN_TPU_PALLAS_CLOSURE mid-process takes
-    effect on the next call instead of being baked into a cached
-    kernel."""
-    import jax
-
-    flag = os.environ.get("JEPSEN_TPU_PALLAS_CLOSURE")
-    on_tpu = jax.default_backend() == "tpu"
-    use_pallas = (flag == "1" or (flag != "0" and on_tpu))
+    cache, so flipping JEPSEN_TPU_PALLAS_CLOSURE (or a checker's
+    pallas= option) mid-process takes effect on the next call instead
+    of being baked into a cached kernel."""
+    use_pallas, on_tpu = _pallas_enabled("JEPSEN_TPU_PALLAS_CLOSURE",
+                                         pallas)
     return _dense_kernel_cached(model_name, s_lo, S, P, E,
                                 use_pallas, on_tpu)
 
@@ -986,6 +1093,116 @@ def _dense_shape(srange: tuple[int, int],
 
 
 # ---------------------------------------------------------------------------
+# Engine cost model: sort vs dense vs pallas variants
+# ---------------------------------------------------------------------------
+#
+# The two kernel families are now both tunable (dense: XLA butterfly vs
+# Pallas closure round; sort: XLA lex-sort vs Pallas hash dedup), so
+# 'auto' picks by a small per-event work model instead of
+# "dense-whenever-it-fits". Units are abstract element-ops with a
+# single cross-family constant (MXU_ADVANTAGE) for work the MXU eats;
+# the constants are calibrated against the r05 hardware numbers
+# (dense 2-6x over sort on the small-S register shapes) and exposed
+# here so a future hardware round can re-fit them in one place.
+
+MXU_ADVANTAGE = 256     # batched-matmul element-ops per VPU-op
+CLOSURE_ROUNDS = 2      # typical stage-B fixpoint depth per invoke
+HASH_PROBE_COST = 6     # serial probe+claim cost per candidate key
+DENSE_EXACT_BIAS = 8.0  # dense verdicts are exact (no frontier, no
+#                         escalation re-runs): prefer dense until its
+#                         modeled cost exceeds the sort family's by
+#                         this factor
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineDecision:
+    """A resolved engine choice for one kernel shape."""
+    family: str                 # 'dense' | 'sort'
+    dense: tuple | None         # (s_lo, S, P) when family == 'dense'
+    dedup: str                  # DEDUP_* (sort family's dedup engine)
+    reason: str
+    costs: dict                 # modeled per-history element-ops
+
+
+def _family_costs(S: int, p_dense: int, p_sort: int, F: int,
+                  n_events: int) -> dict:
+    """Modeled total element-ops per engine variant for a history of
+    n_events over S states and an F frontier. The two families run at
+    DIFFERENT slot counts — the dense table is exact-P (2^p_dense
+    wide) while the sort kernel buckets its slots up (p_sort) — so
+    each row is priced at the count its kernel actually runs."""
+    n = max(int(n_events), 1)
+    C = 1 << min(p_dense, 31)
+    K = F * (1 + p_sort)                  # stage-B dedup candidates
+    W = max(1, (p_sort + 31) // 32)
+    # dense: per invoke, CLOSURE_ROUNDS of the (P,S,S)x(S,C) product
+    # (MXU) + the butterfly OR-accumulate over the table (VPU); plus
+    # the one-off table allocation/init
+    dense = n * CLOSURE_ROUNDS * (p_dense * S * S * C / MXU_ADVANTAGE
+                                  + S * C) + S * C
+    # sort family: per invoke, one lex sort of K rows on (W+2) lanes
+    srt = n * (W + 2) * K * max(np.log2(K), 1.0)
+    # hash dedup: per invoke, one serial probe pass over K keys
+    hsh = n * HASH_PROBE_COST * K
+    return {"dense": dense, "sort": srt, "hash": hsh}
+
+
+def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
+                  *, slots: int | None = None, frontier: int = 256,
+                  engine: str = "auto", dense_slot_cap: int | None = None,
+                  pallas=None) -> EngineDecision:
+    """Pick the kernel family (and the sort family's dedup engine) for
+    one history shape. engine='dense'/'sort' force a family ('dense'
+    raises _dense_caps_error when the table cannot fit, the offline
+    contract); 'auto' runs the cost model. dense_slot_cap bounds the
+    slot count the dense table may be asked to absorb (each slot
+    doubles the table; a checker that knows its histories' tail
+    concurrency can cap the blowup early). pallas=True/False forces
+    the Pallas variants on/off (None = env gate / backend default)."""
+    if engine not in ("auto", "dense", "sort"):
+        raise ValueError(f"unknown WGL engine {engine!r}")
+    if slots is None:
+        slots = _bucket(p_exact, lo=8)
+    S = _bucket(srange[1] - srange[0] + 1, lo=4)
+    costs = _family_costs(S, p_exact, slots, frontier, n_events)
+    dedup = dedup_engine(frontier, slots, _pack_params(srange, slots),
+                         pallas)
+    # the sort family's modeled cost is whichever dedup it will
+    # actually run at this shape — the kernel never mixes engines
+    sort_cost = (costs["hash"] if dedup == DEDUP_PALLAS
+                 else costs["sort"])
+    dense = None
+    if engine in ("auto", "dense"):
+        if dense_slot_cap is not None and p_exact > dense_slot_cap:
+            if engine == "dense":
+                raise ValueError(
+                    f"dense engine requested but the history needs "
+                    f"{p_exact} slots, over dense_slot_cap="
+                    f"{dense_slot_cap}")
+            return EngineDecision(
+                "sort", None, dedup,
+                f"p={p_exact} over dense_slot_cap={dense_slot_cap}",
+                costs)
+        dense = _dense_shape(srange, p_exact)
+        if dense is None and engine == "dense":
+            raise _dense_caps_error(srange, p_exact)
+    if engine == "sort" or dense is None:
+        why = ("forced" if engine == "sort"
+               else f"S={S} x 2^{p_exact} exceeds the dense caps")
+        return EngineDecision("sort", None, dedup, why, costs)
+    if engine == "dense" or \
+            costs["dense"] <= DENSE_EXACT_BIAS * sort_cost:
+        why = ("forced" if engine == "dense" else
+               f"dense {costs['dense']:.3g} <= {DENSE_EXACT_BIAS:g}x "
+               f"{dedup} {sort_cost:.3g}")
+        return EngineDecision("dense", dense, DEDUP_NONE, why, costs)
+    return EngineDecision(
+        "sort", None, dedup,
+        f"dense {costs['dense']:.3g} > {DENSE_EXACT_BIAS:g}x "
+        f"{dedup} {sort_cost:.3g}", costs)
+
+
+# ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 
@@ -1014,7 +1231,9 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
                  cancel=None,
                  explain: bool = True,
                  slot_overflow_fallback: bool = True,
-                 engine: str = "auto") -> dict:
+                 engine: str = "auto",
+                 dense_slot_cap: int | None = None,
+                 pallas=None) -> dict:
     """Check one history on the device. The slot count is sized to the
     history's actual peak concurrency; long histories run as a sequence
     of bounded-duration chunked kernel calls with the frontier carried
@@ -1034,10 +1253,14 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
     culprit op to reconstruct configs and final-paths (the reference
     renders these via knossos.linear.report, `checker.clj:205-216`).
 
-    engine: 'auto' uses the dense reachable-set kernel whenever the
-    model's S x 2^P configuration space fits DENSE_TABLE_CAP (exact
-    verdicts, no frontier), else the sort-frontier kernel; 'dense' /
-    'sort' force one.
+    engine: 'auto' picks by the cost model (see select_engine) over
+    the dense reachable-set kernel (exact verdicts, no frontier,
+    eligible when S x 2^P fits DENSE_TABLE_CAP) and the sort-frontier
+    family; 'dense' / 'sort' force one. dense_slot_cap bounds the slot
+    count 'auto' lets the dense table absorb; pallas=True/False forces
+    the Pallas kernel variants (dense closure round, sort-family hash
+    dedup) on/off, None defers to the JEPSEN_TPU_PALLAS_* env gates
+    (default ON for real TPU backends).
 
     Latency shape: the event stream ships as ONE packed matrix (one
     host->device transfer), and histories that fit a single chunk run
@@ -1066,13 +1289,14 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
         a["analyzer"] = "host-jit-linear (slot overflow)"
         return a
     srange = _state_range(name, model, [ops])
-    dense = None
-    if engine in ("auto", "dense"):
-        dense = _dense_shape(srange, p_exact)
-        if dense is not None:
-            slots = dense[2]   # exact-P: the dense table is 2^P wide
-        elif engine == "dense":
-            raise _dense_caps_error(srange, p_exact)
+    decision = select_engine(srange, p_exact, event_count(ops),
+                             slots=slots, frontier=frontier,
+                             engine=engine,
+                             dense_slot_cap=dense_slot_cap,
+                             pallas=pallas)
+    dense = decision.dense
+    if dense is not None:
+        slots = dense[2]   # exact-P: the dense table is 2^P wide
     steps = build_steps(ops, slots)
     # capacity covers the unmerged stream so the blame re-run below
     # shares this compiled kernel
@@ -1084,9 +1308,11 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
     timed_out = cancelled = False
     while True:
         if dense is not None:
-            k = _dense_kernel(name, dense[0], dense[1], dense[2], E)
+            k = _dense_kernel(name, dense[0], dense[1], dense[2], E,
+                              pallas=pallas)
         else:
-            k = _kernel(name, F, slots, E, _pack_params(srange, slots))
+            k = _kernel(name, F, slots, E, _pack_params(srange, slots),
+                        pallas=pallas)
         if steps.n <= chunk_entries:
             # single fused call: init + full search + verdict
             ok, death, overflow, max_count = jax.device_get(
@@ -1138,6 +1364,12 @@ def analysis_tpu(model, hist, frontier: int = 256, slots: int | None = None,
         "valid?": (True if ok else
                    "unknown" if overflow else False),
         "analyzer": "tpu-wgl-dense" if dense is not None else "tpu-wgl",
+        # the dedup engine the FINAL kernel ran (escalation grows F,
+        # which can push the hash working set out of VMEM mid-search)
+        "dedup": (DEDUP_NONE if dense is not None else
+                  dedup_engine(F, slots, _pack_params(srange, slots),
+                               pallas)),
+        "engine-reason": decision.reason,
         "op-count": len(ops),
         "max-frontier": int(max_count),
         "frontier-size": F,
@@ -1246,7 +1478,9 @@ def _unknown_result(ops, error: str, t0: float) -> dict:
             "duration-ms": (_time.monotonic() - t0) * 1e3}
 
 
-def _dispatch_groups(srange, p_req: list[int], engine: str):
+def _dispatch_groups(srange, p_req: list[int], engine: str,
+                     n_events: int = 1, frontier: int = 1024,
+                     dense_slot_cap: int | None = None, pallas=None):
     """Partition a batch's key indices into slot-bucketed dense dispatch
     groups plus one shared sort-frontier group.
 
@@ -1257,7 +1491,11 @@ def _dispatch_groups(srange, p_req: list[int], engine: str):
     Dense-ineligible keys gain nothing from grouping (the sort frontier
     isn't 2^P-sized), so they spill into a single sort group instead of
     paying one sort-kernel compile per bucket — or, under a forced
-    dense engine, raise.
+    dense engine, raise. Under 'auto' the cost model (select_engine)
+    can also route a dense-*eligible* bucket to the sort family when
+    its table work is modeled slower; n_events is the batch's largest
+    event stream (per-key streams share the verdict of the comparison,
+    which is length-invariant except for the one-off table init).
 
     Returns (dense_groups: {P: (dense_shape, [key indices])},
     sort_idx: [key indices])."""
@@ -1269,6 +1507,13 @@ def _dispatch_groups(srange, p_req: list[int], engine: str):
     for i, p in enumerate(p_req):
         pg = _slot_bucket(p, p_max)
         d = _dense_shape(srange, pg) or _dense_shape(srange, p)
+        if d is not None and engine == "auto":
+            dec = select_engine(srange, d[2], n_events,
+                                frontier=frontier,
+                                dense_slot_cap=dense_slot_cap,
+                                pallas=pallas)
+            if dec.family != "dense":
+                d = None
         if d is None:
             if engine == "dense":
                 raise _dense_caps_error(srange, p, key=i)
@@ -1286,6 +1531,8 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                        budget_s: float | None = None,
                        cancel=None, engine: str = "auto",
                        max_frontier: int = 65536,
+                       dense_slot_cap: int | None = None,
+                       pallas=None,
                        _pre: list | None = None,
                        _dense=False,
                        _preq: list | None = None) -> list[dict]:
@@ -1332,8 +1579,11 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         # can only starve itself of budget, not the cheap keys.
         p_req = [required_slots(ops) for ops in pre]
         srange_all = _state_range(name, model, pre)
-        dense_groups, sort_idx = _dispatch_groups(srange_all, p_req,
-                                                  engine)
+        dense_groups, sort_idx = _dispatch_groups(
+            srange_all, p_req, engine,
+            n_events=max((event_count(o) for o in pre), default=1),
+            frontier=frontier, dense_slot_cap=dense_slot_cap,
+            pallas=pallas)
         group_list = [dense_groups[pg] for pg in sorted(dense_groups)]
         if sort_idx:
             group_list.append((False, sort_idx))
@@ -1354,6 +1604,7 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                     slots=slots, chunk_entries=chunk_entries,
                     budget_s=rem, cancel=cancel, engine=engine,
                     max_frontier=max_frontier,
+                    dense_slot_cap=dense_slot_cap, pallas=pallas,
                     _pre=[pre[i] for i in idx], _dense=d,
                     _preq=[p_req[i] for i in idx])
                 for t, i in enumerate(idx):
@@ -1384,15 +1635,21 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                 p_needs = dict(enumerate(_preq))
             else:
                 p_needs = {i: required_slots(o) for i, o in encoded}
-            dense = _dense_shape(srange, max(p_needs.values())) \
-                if engine in ("auto", "dense") else None
-            if dense is None and engine == "dense":
+            dense = None
+            if engine in ("auto", "dense"):
                 # same contract as the scalar path and the multi-key
                 # grouped split: a forced dense engine never silently
-                # degrades to the sort kernel. Raised BEFORE the budget
-                # early-exit below so the contract violation surfaces
-                # identically for zero-budget calls.
-                raise _dense_caps_error(srange, max(p_needs.values()))
+                # degrades to the sort kernel (select_engine raises).
+                # Decided BEFORE the budget early-exit below so the
+                # contract violation surfaces identically for
+                # zero-budget calls.
+                dense = select_engine(
+                    srange, max(p_needs.values()),
+                    max((event_count(o) for _, o in encoded),
+                        default=1),
+                    frontier=frontier, engine=engine,
+                    dense_slot_cap=dense_slot_cap,
+                    pallas=pallas).dense
         if dense is not None:
             slots = dense[2]
         if ((_remaining() == 0.0) or (cancel is not None and cancel())):
@@ -1409,7 +1666,8 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                 # scalar path re-sizes (and host-falls-back past 256)
                 results[i] = analysis_tpu(
                     model, hists[i], frontier, budget_s=_remaining(),
-                    cancel=cancel, engine=engine)
+                    cancel=cancel, engine=engine,
+                    dense_slot_cap=dense_slot_cap, pallas=pallas)
             else:
                 items.append((i, ops, build_steps(ops, slots)))
     if items and ((_remaining() == 0.0)
@@ -1425,10 +1683,11 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         E = _bucket(max(max(event_count(ops) for _, ops, _ in items), 1))
         padded = [st.pad_to(E) for _, _, st in items]
         if dense is not None:
-            k = _dense_kernel(name, dense[0], dense[1], dense[2], E)
+            k = _dense_kernel(name, dense[0], dense[1], dense[2], E,
+                              pallas=pallas)
         else:
             k = _kernel(name, frontier, slots, E,
-                        _pack_params(srange, slots))
+                        _pack_params(srange, slots), pallas=pallas)
         x = jnp.asarray(np.stack([st.x for st in padded]))
         ns = np.asarray([st.n for st in padded], np.int32)
         s0 = jnp.full(len(padded), model.device_state(), jnp.int32)
@@ -1457,6 +1716,10 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
         ok, death, overflow, max_count = jax.device_get(
             jax.vmap(k.summarize)(carry))
         counts = np.asarray(carry[-2])
+        batch_dedup = (DEDUP_NONE if dense is not None else
+                       dedup_engine(frontier, slots,
+                                    _pack_params(srange, slots),
+                                    pallas))
         # a key is decided if it consumed all entries or its frontier
         # died (death is definitive no matter how many entries remain)
         decided = (np.asarray(carry[0]) >= ns) | (counts == 0)
@@ -1470,6 +1733,7 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
             elif bool(ok[j]):
                 results[i] = {
                     "valid?": True, "analyzer": "tpu-wgl-batch",
+                    "dedup": batch_dedup,
                     "op-count": len(ops),
                     "max-frontier": int(max_count[j]),
                     "configs": [], "final-paths": []}
@@ -1488,6 +1752,7 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                 jnp.full(len(st2s), model.device_state(), jnp.int32)))
             for t, (j, i, ops) in enumerate(invalids):
                 r = {"valid?": False, "analyzer": "tpu-wgl-batch",
+                     "dedup": batch_dedup,
                      "op-count": len(ops),
                      "max-frontier": int(max_count[j]),
                      "configs": [], "final-paths": []}
@@ -1508,7 +1773,8 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
                     frontier=frontier * 4, slots=slots,
                     chunk_entries=chunk_entries, budget_s=_remaining(),
                     cancel=cancel, engine=engine,
-                    max_frontier=max_frontier)
+                    max_frontier=max_frontier,
+                    dense_slot_cap=dense_slot_cap, pallas=pallas)
                 for t, (i, _ops) in enumerate(suspects):
                     results[i] = sub[t]
             else:
@@ -1524,7 +1790,8 @@ def analysis_tpu_batch(model, hists: list, frontier: int = 1024,
     return results  # type: ignore[return-value]
 
 
-def _sharded_runner(name, dense, frontier, slots, srange, E, mesh, axis):
+def _sharded_runner(name, dense, frontier, slots, srange, E, mesh, axis,
+                    pallas=None):
     """The jitted, mesh-sharded batch checker for one kernel shape.
 
     Cached on the full compilation key (kernel shape + mesh) so repeated
@@ -1535,19 +1802,19 @@ def _sharded_runner(name, dense, frontier, slots, srange, E, mesh, axis):
     seconds per dispatch and was the bulk of the sharded path's wall
     time. The dense kernel ignores frontier/slots/srange, so they are
     normalized out of the cache key here — spurious misses can't be
-    reintroduced by a call site. The Pallas-vs-XLA closure choice is
-    resolved here and included in the key, so flipping
-    JEPSEN_TPU_PALLAS_CLOSURE mid-process affects sharded checks the
+    reintroduced by a call site. The Pallas-vs-XLA choices (closure
+    round for the dense family, hash dedup for the sort family) are
+    resolved here and included in the key, so flipping the
+    JEPSEN_TPU_PALLAS_* gates mid-process affects sharded checks the
     same way it affects scalar/batch ones.
     """
-    import jax
-
-    use_pallas = on_tpu = False
     if dense is not None:
         frontier = slots = srange = None
-        flag = os.environ.get("JEPSEN_TPU_PALLAS_CLOSURE")
-        on_tpu = jax.default_backend() == "tpu"
-        use_pallas = (flag == "1" or (flag != "0" and on_tpu))
+        use_pallas, on_tpu = _pallas_enabled(
+            "JEPSEN_TPU_PALLAS_CLOSURE", pallas)
+    else:
+        use_pallas, on_tpu = _pallas_enabled(
+            "JEPSEN_TPU_PALLAS_DEDUP", pallas)
     return _sharded_runner_cached(name, dense, frontier, slots, srange,
                                   E, mesh, axis, use_pallas, on_tpu)
 
@@ -1564,8 +1831,9 @@ def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
             name, dense[0], dense[1], dense[2], E,
             use_pallas, on_tpu).check_batch
     else:
-        check_batch = _kernel(name, frontier, slots, E,
-                              _pack_params(srange, slots)).check_batch
+        check_batch = _kernel_cached(name, frontier, slots, E,
+                                     _pack_params(srange, slots),
+                                     use_pallas, on_tpu).check_batch
 
     # check_vma=False: the kernel's inner lax loops create fresh constants
     # whose varying-manual-axes tags can't match the sharded carries; the
@@ -1591,7 +1859,9 @@ def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
 
 def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
                         frontier: int = 1024, slots: int = 32,
-                        engine: str = "auto"):
+                        engine: str = "auto",
+                        dense_slot_cap: int | None = None,
+                        pallas=None, return_info: bool = False):
     """Shard a batch of independent histories across a device mesh and
     reduce the aggregate verdict with a psum-OR over ICI.
 
@@ -1599,6 +1869,12 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
     verdicts stay sharded until fetched; the scalar verdict is computed
     with an explicit collective so multi-chip runs never gather full
     frontiers to one chip.
+
+    engine / dense_slot_cap / pallas: the same autoselect knobs as
+    analysis_tpu, applied per dispatch group. return_info=True appends
+    a third element: {'groups': [{family, dedup, keys, slots}, ...]} —
+    which engine each slot-bucketed group actually ran (bench artifacts
+    report this).
     """
     import jax
     import jax.numpy as jnp
@@ -1611,6 +1887,8 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
     n_dev = mesh.shape[axis]
     k = len(hists)
     if k == 0:
+        if return_info:
+            return True, np.zeros(0, bool), {"groups": []}
         return True, np.zeros(0, bool)
     pad_k = -(-k // n_dev) * n_dev
 
@@ -1624,7 +1902,11 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
     # hazelcast bench shape (100 keys, ~2.5 crashes/key) the max-padded
     # table sums to 14x the per-key need; grouping recovers it for a
     # couple of extra sub-ms dispatches.
-    dense_groups, sort_idx = _dispatch_groups(srange, p_req, engine)
+    dense_groups, sort_idx = _dispatch_groups(
+        srange, p_req, engine,
+        n_events=max((event_count(o) for o in all_ops), default=1),
+        frontier=frontier, dense_slot_cap=dense_slot_cap, pallas=pallas)
+    group_info: list[dict] = []
 
     def run_group(idx: list[int], dense):
         """One vmapped + mesh-sharded dispatch over the keys in idx."""
@@ -1644,8 +1926,15 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
         padded = [st.pad_to(E) for st in steps_list]
         padded += [Steps.empty(w, E)] * (g_pad - gk)
 
+        group_info.append({
+            "family": "dense" if dense is not None else "sort",
+            "dedup": (DEDUP_NONE if dense is not None else
+                      dedup_engine(frontier, g_slots,
+                                   _pack_params(srange, g_slots),
+                                   pallas)),
+            "keys": gk, "slots": g_slots})
         run = _sharded_runner(name, dense, frontier, g_slots, srange,
-                              E, mesh, axis)
+                              E, mesh, axis, pallas=pallas)
         # async dispatch: return the device arrays unfetched so every
         # group's kernel is enqueued before the first blocking fetch —
         # on a remote relay each synchronous fetch is a full
@@ -1679,9 +1968,13 @@ def check_batch_sharded(model, hists: list, mesh=None, axis: str = "keys",
         idx = np.flatnonzero(suspect)
         subs = analysis_tpu_batch(model, [hists[int(i)] for i in idx],
                                   frontier=frontier * 4, slots=slots,
-                                  engine=engine)
+                                  engine=engine,
+                                  dense_slot_cap=dense_slot_cap,
+                                  pallas=pallas)
         per_key = per_key.copy()
         for t, i in enumerate(idx):
             per_key[i] = subs[t]["valid?"] is True
         all_ok = bool(per_key.all())
+    if return_info:
+        return all_ok, per_key, {"groups": group_info}
     return all_ok, per_key

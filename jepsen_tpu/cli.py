@@ -362,8 +362,12 @@ def test_all_run_tests(tests) -> dict:
     from . import core, store
     out: dict = {}
     for test in tests:
-        test = core.prepare_test(test)
         try:
+            # inside the try: a test map prepare_test rejects (e.g.
+            # duplicate nodes) records as 'crashed' without aborting
+            # the rest of the sweep (dir_name tolerates the missing
+            # start-time)
+            test = core.prepare_test(test)
             done = core.run(test)
             key = (done.get("results") or {}).get("valid?")
         except Exception:

@@ -393,10 +393,22 @@ def with_logging(test: dict):
 
 
 def prepare_test(test: dict) -> dict:
-    """Ensure start-time, concurrency, and barrier fields; always
-    succeeds, and is required before accessing the test's store
-    directory (`core.clj:310-324`)."""
+    """Ensure start-time, concurrency, and barrier fields; required
+    before accessing the test's store directory (`core.clj:310-324`).
+    Validates the node list: a duplicated node would open two control
+    sessions to the same host and only surface much later as a
+    port-bind error on the node, so it fails HERE with a clear
+    message (the CLI's parse_nodes applies the same rule to --node/
+    --nodes/--nodes-file; this covers programmatically-built tests)."""
     test = dict(test)
+    nodes = list(test.get("nodes") or [])
+    dupes = sorted({n for n in nodes if nodes.count(n) > 1})
+    if dupes:
+        raise ValueError(
+            f"test 'nodes' lists node(s) more than once: "
+            f"{', '.join(str(n) for n in dupes)} — each node gets one "
+            f"control session and one client; a duplicate would only "
+            f"fail later as a bind error on the node")
     if not test.get("start-time"):
         test["start-time"] = store.start_time()
     if not test.get("concurrency"):

@@ -144,7 +144,7 @@ class Journal:
             target=self._write_loop, name="jepsen-journal", daemon=True)
         self._writer.start()
 
-    def subscribe(self, fn) -> "Callable[[], None]":
+    def subscribe(self, fn) -> Callable[[], None]:
         """Register fn(op), called synchronously with every appended op
         (the live feed for online/streaming checkers — no disk
         round-trip, no flush-interval lag). fn runs on the appending

@@ -74,6 +74,26 @@ def test_degraded_mode_reports_host_numbers():
                                               "n_txns": 2000}
 
 
+def test_total_budget_exhaustion_soft_fails_with_final_json():
+    """One hung/slow config must never turn the round into rc=1 with
+    no output (the r05 failure mode): sections past the whole-run soft
+    budget are marked {"ok": false, "timeout": true}, the final JSON
+    line still lands, and an over-budget-only round exits 0."""
+    rc, out = _run_bench({"JAX_PLATFORMS": "cpu",
+                          "BENCH_TOTAL_BUDGET_S": "1"})
+    assert rc == 0
+    assert out["error"].startswith("sections-over-budget:")
+    sections = out["extra"]["sections"]
+    # every section accounted for (the orchestrator table), every one
+    # soft-failed rather than silently dropped
+    assert len(sections) == 10
+    for name, meta in sections.items():
+        assert meta == {"ok": False, "timeout": True,
+                        "skipped": "total bench budget exhausted"}, \
+            (name, meta)
+    assert out["value"] is None
+
+
 def test_healthy_cpu_run_full_pipeline():
     # CPU platform: every section runs; value/vs_baseline are real
     rc, out = _run_bench({"JAX_PLATFORMS": "cpu"}, timeout=900)

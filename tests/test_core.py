@@ -289,3 +289,18 @@ def test_prepare_test_defaults():
     assert t["start-time"]
     t0 = core.prepare_test({"nodes": []})
     assert t0["barrier"] == core.NO_BARRIER
+
+
+def test_prepare_test_rejects_duplicate_nodes():
+    """The doc/plan.md 'Validation' graduation: a duplicated node used
+    to surface much later as a bind error on the node — it must fail
+    at test construction with a message naming the culprits."""
+    import pytest
+
+    with pytest.raises(ValueError, match="n2"):
+        core.prepare_test({"nodes": ["n1", "n2", "n2", "n3"]})
+    with pytest.raises(ValueError, match="more than once"):
+        core.prepare_test({"nodes": ["n1"] * 3})
+    # distinct nodes still pass untouched
+    assert core.prepare_test({"nodes": ["n1", "n2"]})["nodes"] == \
+        ["n1", "n2"]
