@@ -14,7 +14,14 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
-# holds the larger shape sweeps, e.g. the pallas dedup parity sweep)
+# holds the larger shape sweeps, e.g. the pallas dedup parity sweep).
+# Device-fault recovery is covered deterministically here via the
+# fault-injection shim (tests/test_recovery.py): set
+# JEPSEN_TPU_FAULT_INJECT=kind@site:n (kind ∈ oom|device-lost|
+# compile|wedged; site ∈ offline|batch|sharded|stream-chunk) to
+# reproduce any bucket by hand against a live entry — e.g.
+#   JEPSEN_TPU_FAULT_INJECT=oom@stream-chunk:3 make tier1
+# exercises the OOM backpressure rung under the whole suite.
 tier1:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 

@@ -374,6 +374,95 @@ def section_streaming():
         }}}
 
 
+def section_recovery():
+    """Checker fault tolerance: checkpoint-cadence overhead (K sweep)
+    and recovery latency vs a cold re-check, on the adversarial 10k
+    history (checker/streaming.py carry checkpoints + the recovery
+    ladder; doc/robustness.md).
+
+    Two numbers matter: what the periodic carry round-trip costs an
+    UNFAULTED stream (cadence_sweep: K=0 disables checkpointing), and
+    what a mid-stream device-lost fault costs to heal — resuming from
+    the last checkpoint (replays ≤K chunks) vs replaying the whole
+    steps log cold (K=0) vs abandoning the stream for a full offline
+    re-check, the pre-recovery behavior."""
+    from jepsen_tpu import _platform as plat
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.streaming import WglStream
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
+    adv = synth.adversarial_register_history(
+        N_OPS, concurrency=6, crashed_writes=8, front_load=True,
+        seed=45100)
+    chunk = max(64, min(1024, N_OPS // 8))
+
+    def stream_once(checkpoint_every, hook=None):
+        plat.fault_hook = hook
+        plat.reset_fault_injection()
+        try:
+            s = WglStream(model, chunk_entries=chunk, engine="dense",
+                          state_range=(-1, 4), concurrency_hint=12,
+                          checkpoint_every=checkpoint_every)
+            t0 = time.monotonic()
+            for op in adv.ops:
+                s.feed(op)
+            r = s.finish()
+            return s, r, time.monotonic() - t0
+        finally:
+            plat.fault_hook = None
+
+    def one_shot(kind, at):
+        state = {"n": 0}
+
+        def hook(site):
+            if site == "stream-chunk":
+                state["n"] += 1
+                if state["n"] == at:
+                    raise plat.InjectedFault(kind, site, at)
+        return hook
+
+    stream_once(0)                           # compile
+    sweep, base_s = {}, None
+    for k in (0, 8, 4, 2, 1):
+        s, r, dt = stream_once(k)
+        assert r["valid?"] is True, f"verdict diverged at K={k}: {r}"
+        if k == 0:
+            base_s = dt
+        sweep[str(k)] = {
+            "seconds": round(dt, 3),
+            "overhead_vs_uncheckpointed": round(dt / base_s - 1, 4)}
+    total_chunks = s._chunks
+
+    # heal a device-lost fault at the stream's midpoint three ways
+    fault_at = max(2, total_chunks // 2)
+    _, r2, ckpt_s = stream_once(2, one_shot("device-lost", fault_at))
+    assert r2["valid?"] is True and r2["recovered"]["retries"] == 1, \
+        f"checkpointed recovery diverged: {r2}"
+    _, r0, cold_s = stream_once(0, one_shot("device-lost", fault_at))
+    assert r0["valid?"] is True \
+        and r0["recovered"]["resumed-from-chunk"] == 0, \
+        f"cold recovery diverged: {r0}"
+    t0 = time.monotonic()
+    off = analysis_tpu(model, adv, budget_s=420)
+    offline_s = time.monotonic() - t0
+    assert off["valid?"] is True
+
+    return {"recovery": {
+        "shape": "adversarial 10k (conc 6, 8 crashed writes, "
+                 "front-loaded), dense engine",
+        "chunks": total_chunks,
+        "cadence_sweep": sweep,
+        "fault_at_chunk": fault_at,
+        "recover_from_checkpoint_s": round(ckpt_s, 3),
+        "recover_cold_replay_s": round(cold_s, 3),
+        "offline_recheck_s": round(offline_s, 3),
+        "recovery_vs_recheck_speedup": round(
+            (base_s + offline_s) / max(ckpt_s, 1e-4), 1),
+        "resumed_from_chunk": r2["recovered"]["resumed-from-chunk"],
+    }}
+
+
 def section_config1():
     """Tutorial-scale 200-op register (CPU parity target)."""
     from jepsen_tpu.checker import synth
@@ -580,6 +669,7 @@ SECTIONS = [
     ("headline", section_headline, 900, True),
     ("adversarial", section_adversarial, 600 + HOST_BUDGET_S, True),
     ("streaming", section_streaming, 900, True),
+    ("recovery", section_recovery, 900, True),
     ("config1", section_config1, 420, True),
     ("config2", section_config2, 480, True),
     ("config3", section_config3, 600, True),
@@ -824,7 +914,7 @@ def main() -> int:
             extra["wgl_best_s"] = payload["wgl_best_s"]
             extra["wgl_engine"] = payload["wgl_engine"]
             extra["wgl_dedup"] = payload.get("wgl_dedup")
-        elif name in ("adversarial", "streaming"):
+        elif name in ("adversarial", "streaming", "recovery"):
             extra.update(payload)
         elif name.startswith("config") or name == "addgraphs":
             configs.update(payload)

@@ -1,4 +1,4 @@
-"""Platform plumbing for driver entry scripts.
+"""Platform plumbing: backend env, fault classification, fault injection.
 
 Some interpreters pre-import jax via sitecustomize and bake a real-TPU
 platform into the live config, overriding any JAX_PLATFORMS set by the
@@ -7,11 +7,254 @@ caller (config beats env once the plugin has registered);
 stay hermetic and a deliberately-invalid platform (how the bench tests
 simulate a dead backend) genuinely fails init instead of silently
 reaching the chip. (The test conftest goes further and forces CPU
-unconditionally.)"""
+unconditionally.)
+
+This module is also the one place the checkers learn what a backend
+failure *means*. jax surfaces every device-path failure as a
+RuntimeError (usually an XlaRuntimeError), which tells a recovery
+ladder nothing about what to do next; `classify_backend_error` buckets
+them into the four faults a production checking service on preemptible
+TPUs actually sees — OOM, device loss/preemption, compile failure, and
+a wedged backend — and returns None for ordinary RuntimeErrors, which
+are checker bugs, not device faults, and must never trigger recovery
+(or masquerade as degradation in `check_safe`).
+
+Because real faults are hard to produce on demand, the same module
+carries the test-only injection shim: `maybe_inject_fault(site)` is
+called immediately before every recovery-aware device dispatch, and
+either the `JEPSEN_TPU_FAULT_INJECT` env knob (``kind@site:n`` — raise
+an InjectedFault of `kind` at the n-th dispatch on `site`) or the
+monkeypatchable `fault_hook` makes each bucket deterministically
+reproducible in tier-1, on CPU, with no hardware."""
 
 from __future__ import annotations
 
 import os
+
+# Fault buckets (classify_backend_error return values). Anything the
+# classifier recognizes as a backend failure but cannot place more
+# precisely lands in FAULT_WEDGED — the "wedged-other" rung, handled
+# with a plain bounded retry.
+FAULT_OOM = "oom"
+FAULT_DEVICE_LOST = "device-lost"
+FAULT_COMPILE = "compile"
+FAULT_WEDGED = "wedged"
+FAULT_KINDS = (FAULT_OOM, FAULT_DEVICE_LOST, FAULT_COMPILE, FAULT_WEDGED)
+
+FAULT_INJECT_ENV = "JEPSEN_TPU_FAULT_INJECT"
+SYNC_DEADLINE_ENV = "JEPSEN_TPU_SYNC_DEADLINE_S"
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic stand-in for a backend fault (test/bench only).
+
+    Subclasses RuntimeError — the same surface jax's real backend
+    errors present — so the recovery ladders exercise exactly the
+    production catch/classify/retry path."""
+
+    def __init__(self, kind: str, site: str, seq: int):
+        super().__init__(
+            f"injected {kind} fault at {site} dispatch #{seq}")
+        self.kind = kind
+
+
+class WedgedDeviceSync(RuntimeError):
+    """A blocking device sync exceeded its watchdog deadline.
+
+    Raised by guarded_device_get; per util.timeout semantics the
+    blocked fetch is *abandoned*, not killed — it may still complete in
+    the background, and its late result is discarded. Classified as
+    FAULT_WEDGED so the recovery ladders treat a hung TPU call as a
+    recoverable fault instead of hanging analyze forever."""
+
+    kind = FAULT_WEDGED
+
+
+def _xla_error_types() -> tuple:
+    """jax's backend-error classes, lazily (jax may not be imported —
+    or even importable — when the host-only paths run)."""
+    types: tuple = ()
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        types += (XlaRuntimeError,)
+    except ImportError:
+        pass
+    try:
+        from jax.errors import JaxRuntimeError
+        if JaxRuntimeError not in types:
+            types += (JaxRuntimeError,)
+    except ImportError:
+        pass
+    return types
+
+
+# message fragments → bucket, checked in order (an OOM message may also
+# contain "allocator", a preemption may mention the device — first
+# match wins, and the more specific buckets come first)
+_FAULT_PATTERNS = (
+    (FAULT_OOM, ("resource_exhausted", "out of memory", "oom",
+                 "allocation failure", "failed to allocate")),
+    (FAULT_DEVICE_LOST, ("device_lost", "device lost", "unavailable",
+                         "preempt", "halted", "device or chip",
+                         "data_loss", "connection reset")),
+    (FAULT_COMPILE, ("mosaic", "compilation", "compile",
+                     "unimplemented", "lowering")),
+    (FAULT_WEDGED, ("deadline_exceeded", "timed out", "timeout")),
+)
+
+
+# jax's backend-*initialization* failures are plain RuntimeErrors
+# (xla_bridge.py raises RuntimeError(f"Unable to initialize backend
+# '{platform}': ...")); libtpu init failures surface similarly. These
+# exact signatures classify as device-lost even without the
+# XlaRuntimeError type.
+_PLAIN_INIT_FRAGS = ("unable to initialize backend",
+                     "failed to initialize tpu")
+
+
+def classify_backend_error(exc: BaseException) -> str | None:
+    """Bucket a backend failure into one of FAULT_KINDS, or None when
+    the exception is an ordinary bug rather than the device path
+    falling over.
+
+    Only jax's XlaRuntimeError family (plus this module's own fault
+    types, which carry an explicit ``kind``) classify: a plain
+    RuntimeError raised by checker logic returns None, so recovery
+    ladders re-raise it and `check_safe` reports it as a checker error
+    instead of device degradation. An XlaRuntimeError whose message
+    matches no pattern still classifies — as FAULT_WEDGED, the
+    retry-and-see bucket. The one plain-RuntimeError carve-out is
+    backend *initialization* failure (_PLAIN_INIT_FRAGS): xla_bridge
+    raises those untyped, and they are unambiguously the device path
+    falling over. Those fragments are matched as substrings — jax
+    prepends status prefixes like 'INTERNAL:' so anchoring to the
+    message start would miss them — but each is a full distinctive
+    phrase, not a keyword, so a checker bug only matches by quoting
+    the backend's own failure text (in which case device-lost is the
+    right call anyway)."""
+    kind = getattr(exc, "kind", None)
+    if kind in FAULT_KINDS:
+        return kind
+    if not isinstance(exc, _xla_error_types()):
+        # one narrow exception to the XlaRuntimeError-only rule: jax's
+        # xla_bridge raises a PLAIN RuntimeError when a backend fails
+        # to initialize (a dead/unreachable device at first touch) —
+        # that is the device path falling over, not a checker bug, so
+        # it must reach the device-lost rung. The allowlist holds full
+        # distinctive phrases (matched as substrings — jax prepends
+        # status prefixes like 'INTERNAL:'), not keywords, so checker
+        # bugs don't match unless they quote the backend's own text.
+        if type(exc) is RuntimeError:
+            msg = str(exc).lower()
+            if any(f in msg for f in _PLAIN_INIT_FRAGS):
+                return FAULT_DEVICE_LOST
+        return None
+    msg = str(exc).lower()
+    for bucket, frags in _FAULT_PATTERNS:
+        if any(f in msg for f in frags):
+            return bucket
+    return FAULT_WEDGED
+
+
+def backend_reinit() -> None:
+    """Best-effort in-process backend re-initialization after a
+    device-lost fault: drop jax's live compiled-executable caches so
+    the next dispatch rebuilds device state instead of re-poking dead
+    buffers. The kernel-level LRU caches (wgl._kernel and friends) are
+    cleared by the callers that own them."""
+    try:
+        import jax
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 — reinit is best-effort by design
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (tests / bench only)
+# ---------------------------------------------------------------------------
+
+# Monkeypatchable hook around dispatch: fn(site) -> None, may raise.
+# Checked on every maybe_inject_fault call, before the env knob.
+fault_hook = None
+
+_fault_seq: dict[str, int] = {}
+
+
+def reset_fault_injection() -> None:
+    """Zero the per-site dispatch counters (each test starts its own
+    deterministic injection schedule)."""
+    _fault_seq.clear()
+
+
+def maybe_inject_fault(site: str) -> None:
+    """Called immediately before each recovery-aware device dispatch.
+
+    Sites in use: 'offline' (wgl.analysis_tpu), 'batch'
+    (wgl.analysis_tpu_batch), 'sharded' (wgl.check_batch_sharded),
+    'stream-chunk' (streaming.WglStream). The env spec is a
+    comma-separated list of ``kind@site:n`` clauses; the n-th dispatch
+    on a matching site raises InjectedFault(kind) (n is 1-based and
+    counts every dispatch since reset_fault_injection(), so a
+    recovery retry advances the counter past the clause — the fault
+    fires once, like a real transient)."""
+    n = _fault_seq.get(site, 0) + 1
+    _fault_seq[site] = n
+    hook = fault_hook
+    if hook is not None:
+        hook(site)
+    spec = os.environ.get(FAULT_INJECT_ENV)
+    if not spec:
+        return
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, _, rest = clause.partition("@")
+        tsite, _, seq = rest.partition(":")
+        if tsite == site and n == int(seq or 1):
+            raise InjectedFault(kind, site, n)
+
+
+# ---------------------------------------------------------------------------
+# Watchdog: bounded device syncs
+# ---------------------------------------------------------------------------
+
+def sync_deadline_s() -> float | None:
+    """The watchdog deadline for blocking device syncs, from
+    JEPSEN_TPU_SYNC_DEADLINE_S (seconds; unset/0 = unbounded, the
+    pre-watchdog behavior — the knob exists because a deadline costs
+    one daemon thread per guarded sync)."""
+    raw = os.environ.get(SYNC_DEADLINE_ENV)
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def guarded_device_get(x, deadline_s: float | None = None,
+                       site: str = "device-sync"):
+    """jax.device_get under a watchdog deadline: a wedged TPU call
+    becomes a WedgedDeviceSync (a classified, recoverable fault)
+    instead of blocking its caller forever. deadline_s=None defers to
+    the env knob; with neither set this is a plain device_get with no
+    thread spawned."""
+    import jax
+
+    if deadline_s is None:
+        deadline_s = sync_deadline_s()
+    if not deadline_s:
+        return jax.device_get(x)
+    from .util import TIMED_OUT, timeout
+    r = timeout(deadline_s, lambda: jax.device_get(x),
+                default=TIMED_OUT, name=f"jepsen-watchdog {site}")
+    if r is TIMED_OUT:
+        raise WedgedDeviceSync(
+            f"device sync at {site} still blocked after {deadline_s}s "
+            f"(watchdog); treating the backend as wedged")
+    return r
 
 
 def honor_platform_env() -> None:

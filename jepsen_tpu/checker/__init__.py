@@ -16,6 +16,7 @@ import threading
 import traceback
 from typing import Any, Callable, Mapping
 
+from .._platform import classify_backend_error
 from ..history import History, history
 from ..util import bounded_pmap
 
@@ -99,10 +100,20 @@ def check_safe(checker, test, hist, opts=None, name=None) -> dict:
     """check(), but exceptions come back as {'valid?': 'unknown', ...}
     (reference checker.clj:74-85). The payload names the checker that
     failed ('checker') so a traceback inside compose stays
-    attributable. A RuntimeError — how jax surfaces backend/XLA
-    failures (device init, device OOM) — additionally reports
-    'degraded': True: the checker didn't find an anomaly, the device
-    path fell over underneath it."""
+    attributable.
+
+    Backend failures are routed through
+    `_platform.classify_backend_error`: only an exception the
+    classifier recognizes (jax's XlaRuntimeError family — device init,
+    device OOM, preemption, a wedged sync — plus the platform module's
+    own classified fault types) reports 'degraded': True with its
+    'fault' bucket. An ordinary checker bug raised as a plain
+    RuntimeError is NOT degradation — the device path didn't fall
+    over, the checker is wrong — and reports like any other crash.
+    (Reaching here at all means the entry's own recovery ladder
+    already spent its budget: the ladders in checker/wgl.py and
+    checker/streaming.py absorb classified faults and re-run before
+    anything escapes to this level.)"""
     cname = name if name is not None else checker_name(checker)
     try:
         return coerce(checker).check(test, history(hist), opts or {})
@@ -111,17 +122,27 @@ def check_safe(checker, test, hist, opts=None, name=None) -> dict:
         # backend falling over
         return {"valid?": UNKNOWN, "checker": cname,
                 "error": traceback.format_exc()}
-    except RuntimeError:
-        return {"valid?": UNKNOWN, "checker": cname, "degraded": True,
-                "error": traceback.format_exc()}
-    except Exception:  # noqa: BLE001 — checker crashes must not kill the run
+    except Exception as e:  # noqa: BLE001 — crashes must not kill the run
+        kind = classify_backend_error(e)
+        if kind is not None:
+            return {"valid?": UNKNOWN, "checker": cname,
+                    "degraded": True, "fault": kind,
+                    "error": traceback.format_exc()}
         return {"valid?": UNKNOWN, "checker": cname,
                 "error": traceback.format_exc()}
 
 
 class Compose(Checker):
     """Runs a map of named checkers (in parallel) and merges validity
-    (reference checker.clj:87-99)."""
+    (reference checker.clj:87-99).
+
+    Device-fault outcomes are summarized across the composition:
+    'recovered-checkers' names sub-checkers whose results carry a
+    recovery trail (the device faulted but the verdict was resumed —
+    full recovery), 'degraded-checkers' names those that lost their
+    verdict to faults past the recovery budget (partial degradation).
+    The two are distinct outcomes: a recovered composition is
+    complete, a degraded one is missing answers."""
 
     def __init__(self, checker_map: Mapping[str, Any]):
         self.checkers = {k: coerce(c) for k, c in checker_map.items()}
@@ -136,6 +157,18 @@ class Compose(Checker):
         out: dict = dict(results)
         out["valid?"] = merge_valid(
             r.get("valid?", True) for _, r in results if r is not None)
+        # a recovery trail is a dict ({'faults': ..., 'retries': ...});
+        # workload checkers reuse the 'recovered' key for their own
+        # payloads (e.g. the set checker's recovered-element string)
+        recovered = sorted(k for k, r in results
+                           if isinstance(r, dict)
+                           and isinstance(r.get("recovered"), dict))
+        degraded = sorted(k for k, r in results
+                          if isinstance(r, dict) and r.get("degraded"))
+        if recovered:
+            out["recovered-checkers"] = recovered
+        if degraded:
+            out["degraded-checkers"] = degraded
         return out
 
 

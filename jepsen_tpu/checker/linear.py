@@ -262,6 +262,15 @@ class Linearizable(Checker):
       frontier / max_frontier / chunk_entries / budget_s — the sort
                      family's frontier sizing, escalation cap, device
                      call granularity, and wall-clock budget
+      max_recovery_retries int — device-fault recovery budget: how
+                     many classified backend faults (OOM / device
+                     lost / compile / wedged) the entry absorbs and
+                     retries before taking its final rung (host
+                     mirror under the size cap). Defaults to
+                     wgl.MAX_RECOVERY_RETRIES; the test map's
+                     'max-recovery-retries' (CLI
+                     --max-recovery-retries) applies when the option
+                     is unset here.
 
     e.g. ``linearizable({'model': m, 'engine': 'dense',
     'budget_s': 120})`` or ``linearizable(m, dense_slot_cap=12,
@@ -296,14 +305,18 @@ class Linearizable(Checker):
             algo = "tpu"
         if algo not in ("auto", "tpu", "host", "competition"):
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
+        kw = dict(self.opts)
+        mrr = (test or {}).get("max-recovery-retries")
+        if mrr is not None:
+            kw.setdefault("max_recovery_retries", mrr)
         a = None
         if algo == "competition" and self.model.device_model is not None:
-            a = self._compete(hist)
+            a = self._compete(hist, kw)
         elif algo in ("auto", "tpu", "competition"):
             if self.model.device_model is not None:
                 try:
                     from .wgl import analysis_tpu
-                    a = analysis_tpu(self.model, hist, **self.opts)
+                    a = analysis_tpu(self.model, hist, **kw)
                 except ImportError:
                     if algo == "tpu":
                         raise
@@ -349,7 +362,7 @@ class Linearizable(Checker):
             return None
         return _truncate(dict(r))
 
-    def _compete(self, hist) -> dict:
+    def _compete(self, hist, base_opts: dict | None = None) -> dict:
         """Race the host search against the device kernel; first
         definitive (non-'unknown') verdict wins, loser is cancelled."""
         import queue as _queue
@@ -365,7 +378,7 @@ class Linearizable(Checker):
                 results.put((name, {"valid?": UNKNOWN, "error": repr(e)}))
 
         from .wgl import analysis_tpu
-        opts = dict(self.opts)
+        opts = dict(base_opts if base_opts is not None else self.opts)
         opts["explain"] = False  # explain after the race, not during it
         # on slot overflow the device path would duplicate the racing
         # host thread's search — make it concede instead

@@ -56,10 +56,13 @@ import logging
 import queue as _queue
 import threading
 import time as _time
+import traceback
 from typing import Any, Callable
 
 import numpy as np
 
+from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST, FAULT_OOM,
+                         guarded_device_get, maybe_inject_fault)
 from ..history import (KIND_INFO, KIND_OK, NIL, PENDING_RET,
                        DeviceEncodingError, History, OpArray,
                        history as as_history)
@@ -69,6 +72,13 @@ from . import wgl as _wgl
 log = logging.getLogger(__name__)
 
 DEFAULT_CHUNK_ENTRIES = 1024
+# Carry-checkpoint cadence: every K chunks the device carry round-trips
+# to host memory (one extra blocking sync per K chunks), so recovery
+# from a backend fault replays at most K chunks instead of the whole
+# stream. 0 disables checkpointing (recovery then replays from chunk
+# 0 — still correct, just cold). See doc/robustness.md for cadence
+# guidance.
+DEFAULT_CHECKPOINT_EVERY = 8
 
 # row resolution states (kind uses history.KIND_* once resolved)
 _UNRESOLVED = -1
@@ -271,10 +281,21 @@ class WglStream:
     range trigger a transparent rebuild: dense -> sort, packed sort
     -> unpacked sort.
 
-    NOTE the carry round-trip caveat from wgl.run_range: the carry is
-    checkpointable through host memory, but the streaming path never
-    round-trips it mid-run — it stays device-resident; only the
-    per-chunk liveness flag (one int) crosses back.
+    Fault tolerance: the carry round-trips to host memory every
+    `checkpoint_every` chunks (reusing wgl.run_range's carry
+    checkpointability), so a classified backend fault mid-stream —
+    OOM, device loss/preemption, compile failure, a wedged sync —
+    recovers by reinitializing the kernel, restoring the last
+    checkpoint, and replaying at most `checkpoint_every` chunks from
+    the dispatched-steps log instead of surfacing as a lost verdict.
+    The OOM rung additionally applies backpressure: the dense engine
+    re-selects onto the sort family (the table is the memory hog) and
+    the sort engine halves `chunk_entries`. The encoder is host-side
+    and untouched by device faults, so a resumed stream's emitted
+    step rows are byte-identical to an uninterrupted run's and the
+    verdict/certificate are identical too (pinned by
+    tests/test_recovery.py). A recovered stream reports its trail
+    under 'recovered' in finish()'s analysis.
     """
 
     def __init__(self, model, *, slots: int | None = None,
@@ -283,7 +304,9 @@ class WglStream:
                  engine: str = "sort",
                  state_range: tuple[int, int] | None = None,
                  concurrency_hint: int | None = None,
-                 pallas=None):
+                 pallas=None,
+                 checkpoint_every: int = DEFAULT_CHECKPOINT_EVERY,
+                 max_recovery_retries: int | None = None):
         name = model.device_model
         if name is None or name not in _wgl.DEVICE_MODELS:
             raise ValueError(f"model {model!r} has no device form")
@@ -334,6 +357,25 @@ class WglStream:
         self._bufs: list[np.ndarray] | None = None
         self._pad_row: np.ndarray | None = None
         self._steps_log: list[np.ndarray] = []   # dispatched step slices
+        # fault tolerance: carry checkpoints + the recovery trail
+        # (classification / budget / backoff policy lives in ONE place,
+        # wgl._RecoveryTrail — the stream only adds checkpoint restore)
+        self.checkpoint_every = max(0, int(checkpoint_every))
+        self._trail = _wgl._RecoveryTrail(max_recovery_retries)
+        # (rows consumed, chunks dispatched, host-resident carry)
+        self._ckpt: tuple[int, int, tuple] | None = None
+        self._rows_fed = 0        # step rows appended to the log
+        self._rows_done = 0       # step rows the device has consumed
+        self._resumed_from_chunk: int | None = None
+        self._last_fault: BaseException | None = None
+
+    @property
+    def faults(self) -> list:
+        return self._trail.faults
+
+    @property
+    def max_recovery_retries(self) -> int:
+        return self._trail.max
 
     # -- engine / kernel management ---------------------------------------
 
@@ -381,6 +423,135 @@ class WglStream:
         # compile warm-up: consumes nothing, leaves the carry untouched
         self._carry = self._k.check_stream_chunk(
             self._bufs[0], jnp.int32(0), self._carry)
+
+    # -- fault tolerance ---------------------------------------------------
+
+    def _absorb_fault(self, exc: BaseException, site: str) -> bool:
+        """Classify + record a backend fault; True when another retry
+        is allowed (after the backoff sleep), False when the budget is
+        spent. Exceptions the classifier rejects — ordinary bugs — are
+        re-raised by the trail: they must never trigger recovery."""
+        self._last_fault = exc
+        return self._trail.absorb(exc, f"online WGL stream {site}")
+
+    def _apply_stream_rung(self, kind: str) -> None:
+        """Mutate the stream's knobs per the fault bucket before the
+        retry. Every rung drops the kernel so the retry rebuilds it."""
+        if kind == FAULT_OOM:
+            if self.engine == "dense":
+                # the dense table is the memory hog: re-select onto the
+                # sort family. A dense checkpoint cannot seed a sort
+                # carry, so recovery replays the whole log (cold but
+                # correct); range escapes were already impossible here,
+                # so the packed sort stays available.
+                log.warning("online WGL stream: OOM on the dense "
+                            "engine; re-selecting onto the sort family")
+                self.engine = "sort"
+                self._ckpt = None
+            else:
+                self.chunk = _wgl._bucket(max(self.chunk // 2, 64),
+                                          lo=64)
+                log.warning("online WGL stream: OOM backpressure; "
+                            "chunk_entries now %d", self.chunk)
+        elif kind == FAULT_DEVICE_LOST:
+            _wgl._device_reinit()
+        elif kind == FAULT_COMPILE:
+            self.pallas = False
+        self._k = None
+
+    def _restore_and_replay(self) -> None:
+        """Rebuild the kernel, restore the last carry checkpoint, and
+        replay the dispatched-steps log from its row index — the
+        recovery resume. The encoder and steps log are host-side and
+        untouched, so the replayed stream is byte-identical to the
+        uninterrupted one."""
+        import jax.numpy as jnp
+
+        self._k = None
+        self._setup()
+        if self._ckpt is not None:
+            rows0, chunks0, host = self._ckpt
+            self._carry = tuple(jnp.asarray(a) for a in host)
+        else:
+            rows0, chunks0 = 0, 0
+        self._resumed_from_chunk = chunks0
+        self._rows_done = rows0
+        # rewind the chunk counter too: the replay loop re-increments
+        # it per slice, so it lands back at the live chunk count —
+        # otherwise later checkpoints and the violation log would
+        # count replayed dispatches on top of live ones
+        self._chunks = chunks0
+        # collect only the rows past the checkpoint, walking the log
+        # from the end — concatenating the whole stream to slice its
+        # tail would make recovery cost O(stream), not O(replay)
+        need = sum(len(a) for a in self._steps_log) - rows0
+        parts: list[np.ndarray] = []
+        got = 0
+        for a in reversed(self._steps_log):
+            if got >= need:
+                break
+            parts.append(a)
+            got += len(a)
+        parts.reverse()
+        tail = (np.concatenate(parts)[-need:] if need > 0
+                else np.zeros((0, self.encoder.w + 4), np.int32))
+        for e in range(0, len(tail), self.chunk):
+            sl = tail[e:e + self.chunk]
+            maybe_inject_fault("stream-chunk")
+            # fresh staging per slice: unlike the live path, this loop
+            # enqueues without a per-chunk liveness sync, so reusing
+            # the double buffers could rewrite one still feeding an
+            # in-flight async chunk
+            buf = np.repeat(self._pad_row[None], self.chunk, axis=0)
+            buf[:len(sl)] = sl
+            self._carry = self._k.check_stream_chunk(
+                jnp.asarray(buf), jnp.int32(len(sl)), self._carry)
+            self._chunks += 1
+            self._rows_done += len(sl)
+            self._maybe_checkpoint()
+        if not self._dead:
+            self._check_death(self._carry)
+        log.info("online WGL stream resumed from chunk %d "
+                 "(replayed %d step rows)", chunks0, len(tail))
+
+    def _maybe_checkpoint(self) -> None:
+        """Round-trip the carry to host memory every checkpoint_every
+        chunks. The blocking fetch also FORCES completion of every
+        async chunk enqueued so far, so a stored checkpoint is known
+        good — a fault in flight surfaces here and recovery falls back
+        to the previous one."""
+        if not self.checkpoint_every \
+                or self._chunks % self.checkpoint_every:
+            return
+        host = guarded_device_get(self._carry, site="stream checkpoint")
+        self._ckpt = (self._rows_done, self._chunks, host)
+
+    def _recovering(self, fn: Callable[[], Any], site: str,
+                    restore: bool = True):
+        """Run a device-side closure under the recovery ladder: a
+        classified backend fault applies its rung, restores the last
+        checkpoint, replays the steps log, and retries fn. Returns
+        fn()'s value, or None when the retry budget is spent (the
+        caller decides its final rung — _dispatch disables the stream,
+        finish degrades to offline, blame keeps the verdict).
+
+        restore=False skips the checkpoint-restore/replay between
+        retries — for closures that build their own kernel and carry
+        (escalation, blame) and would never read the restored
+        self._carry; replaying the whole steps log for them would
+        double the device work of every transient fault."""
+        replay = False
+        while True:
+            try:
+                if replay:
+                    self._restore_and_replay()
+                    replay = False
+                return fn()
+            except RuntimeError as e:
+                if not self._absorb_fault(e, site):
+                    return None
+                self._apply_stream_rung(self.faults[-1])
+                replay = restore
 
     # -- feeding ----------------------------------------------------------
 
@@ -444,6 +615,10 @@ class WglStream:
         self._k = None
         self._steps_log = []
         self._chunks = 0
+        # a rebuild replaces the kernel family/shape: the old carry
+        # checkpoint no longer matches and the steps log restarts
+        self._ckpt = None
+        self._rows_fed = self._rows_done = 0
         self._dead = self._dead_overflow = False
         self.violation = False
         self.violation_at_op = None
@@ -454,6 +629,11 @@ class WglStream:
     def _pump(self, partial: bool = False) -> None:
         """Dispatch full chunks (and, when partial=True, the tail)."""
         while True:
+            if self._failed is not None:
+                # the recovery budget died mid-drain: every further
+                # chunk would re-attempt a kernel build + dispatch on
+                # the broken backend (each up to a watchdog deadline)
+                return
             avail = self.encoder.available()
             if avail == 0 or (avail < self.chunk and not partial):
                 return
@@ -483,12 +663,27 @@ class WglStream:
 
     def _dispatch(self, arr: np.ndarray) -> None:
         self._steps_log.append(arr)
+        self._rows_fed += len(arr)
         if self._dead and not self._dead_overflow:
             return   # verdict already definite; no device work left
+        if self._recovering(lambda: self._dispatch_once(arr) or True,
+                            "dispatch") is None:
+            # recovery budget spent: disable the stream — the offline
+            # checker (whose own ladder ends at the host mirror) covers
+            self._failed = self._last_fault or RuntimeError(
+                "stream recovery budget exhausted")
+            log.warning("online WGL stream disabled after %d backend "
+                        "faults (%s); the offline checker will run "
+                        "instead", len(self.faults), self._failed)
+
+    def _dispatch_once(self, arr: np.ndarray) -> None:
         import jax.numpy as jnp
 
+        if self._rows_done >= self._rows_fed:
+            return   # a recovery replay already consumed this slice
         if self._k is None:
             self._setup()
+        maybe_inject_fault("stream-chunk")
         buf = self._bufs[self._chunks % 2]
         n = len(arr)
         buf[:n] = arr
@@ -498,17 +693,18 @@ class WglStream:
         self._carry = self._k.check_stream_chunk(
             jnp.asarray(buf), jnp.int32(n), self._carry)
         self._chunks += 1
+        self._rows_done += n
         if not self._dead:
             # one host<->device sync per chunk, one chunk behind: the
             # flag we block on is the PREVIOUS chunk's output, already
             # produced while we were encoding this one — the poll
             # overlaps compute instead of serializing after it
             self._check_death(prev)
+        self._maybe_checkpoint()
 
     def _check_death(self, carry) -> None:
-        import jax
-        ok, _death, overflow, _maxc = jax.device_get(
-            self._k.summarize(carry))
+        ok, _death, overflow, _maxc = guarded_device_get(
+            self._k.summarize(carry), site="stream liveness")
         self._chunk_syncs += 1
         if not bool(ok):
             self._dead = True
@@ -544,8 +740,6 @@ class WglStream:
     def finish(self) -> dict | None:
         """Drain the tail, settle the verdict (escalating overflowed
         invalids like the offline path), and return the analysis."""
-        import jax
-
         if self._failed is not None:
             return None
         t_tail = _time.monotonic()
@@ -569,8 +763,6 @@ class WglStream:
                 return None
             if self.encoder is enc and enc.finished:
                 break
-        if self._k is None:
-            self._setup()   # zero-op run: still produce a verdict
         ops = self.encoder.op_array()
         if self.dm.validate is not None:
             try:
@@ -578,8 +770,17 @@ class WglStream:
             except DeviceEncodingError as e:
                 log.warning("online WGL verdict discarded: %s", e)
                 return None
-        ok, death, overflow, max_count = jax.device_get(
-            self._k.summarize(self._carry))
+
+        def _settle():
+            if self._k is None:
+                self._setup()   # zero-op run: still produce a verdict
+            return guarded_device_get(
+                self._k.summarize(self._carry), site="stream summarize")
+
+        settled = self._recovering(_settle, "summarize")
+        if settled is None:
+            return None   # budget spent; offline checking covers
+        ok, death, overflow, max_count = settled
         ok, overflow = bool(ok), bool(overflow)
         F = self.frontier
         all_steps = (np.concatenate(self._steps_log)
@@ -590,13 +791,26 @@ class WglStream:
             # invalid under overflow: the witness may have been dropped
             # — replay everything at 4x the frontier (offline contract)
             F *= 4
-            k2 = _wgl._kernel(self.name, F, self.p, self.chunk,
-                              self._pack, pallas=self.pallas)
-            carry = self._replay(all_steps, k2)
-            ok, death, overflow, max_count = jax.device_get(
-                k2.summarize(carry))
+
+            def _escalate(F=F):
+                k2 = _wgl._kernel(self.name, F, self.p, self.chunk,
+                                  self._pack, pallas=self.pallas)
+                carry = self._replay(all_steps, k2)
+                return k2, guarded_device_get(
+                    k2.summarize(carry), site="stream escalate")
+
+            esc = self._recovering(_escalate, "escalate",
+                                   restore=False)
+            if esc is None:
+                return None
+            k2, (ok, death, overflow, max_count) = esc
             ok, overflow = bool(ok), bool(overflow)
             self._k = k2
+            # keep the stream's frontier in lockstep with the kernel:
+            # a fault during blame rebuilds via _setup(), which reads
+            # self.frontier — rebuilding at the pre-escalation size
+            # would re-overflow and drop the witness
+            self.frontier = F
         now = _time.monotonic()
         out = {
             "valid?": (True if ok else UNKNOWN if overflow else False),
@@ -620,6 +834,12 @@ class WglStream:
             "configs": [],
             "final-paths": [],
         }
+        if self.faults:
+            rec = {"faults": list(self.faults),
+                   "retries": len(self.faults)}
+            if self._resumed_from_chunk is not None:
+                rec["resumed-from-chunk"] = self._resumed_from_chunk
+            out["recovered"] = rec
         if self.violation:
             out["violation-at-op"] = self.violation_at_op
         if not ok:
@@ -635,15 +855,26 @@ class WglStream:
         """Name the culprit op: unmerged replay through the same
         chunk-shaped kernel (the merged stream cannot name one), then
         host explain on the prefix — the offline invalid contract."""
-        import jax
-
         try:
             steps = _wgl.build_steps(ops, self.p, merge=False)
         except _wgl.SlotOverflow:   # cannot happen: same p as merged
             return
-        carry = self._replay(steps.x, self._k)
-        ok, death, _ovf, _maxc = jax.device_get(
-            self._k.summarize(carry))
+
+        def _run():
+            if self._k is None:   # a recovery rung dropped the kernel
+                self._setup()
+            carry = self._replay(steps.x, self._k)
+            return guarded_device_get(
+                self._k.summarize(carry), site="stream blame")
+
+        r = self._recovering(_run, "blame", restore=False)
+        if r is None:
+            # blame is best-effort: the verdict is already decided,
+            # only the certificate detail is lost
+            log.warning("online blame replay abandoned after backend "
+                        "faults; verdict kept without a culprit op")
+            return
+        ok, death, _ovf, _maxc = r
         d = int(death)
         if bool(ok) or d < 0:
             return
@@ -918,6 +1149,7 @@ class OnlineChecker:
         self.targets = dict(targets)
         self.abort_on_violation = abort_on_violation
         self.aborted = False
+        self.driver_error: str | None = None
         self._abort = threading.Event()
         self._q: _queue.SimpleQueue = _queue.SimpleQueue()
         self._results: dict[str, dict] = {}
@@ -933,6 +1165,20 @@ class OnlineChecker:
         return self._abort.is_set()
 
     def _run(self) -> None:
+        # the driver thread must not die silently: an uncaught
+        # exception here used to discard every streamed result with no
+        # trace — now it stamps driver_error, finalize() marks the
+        # streamed-results degraded, and core.run's offline re-check
+        # covers the targets
+        try:
+            self._run_inner()
+        except BaseException:  # noqa: BLE001 — thread boundary
+            self.driver_error = traceback.format_exc()
+            log.warning("online checker driver thread crashed; "
+                        "streamed results are discarded and offline "
+                        "checking covers them", exc_info=True)
+
+    def _run_inner(self) -> None:
         dead: set[str] = set()
         while True:
             op = self._q.get()
@@ -971,7 +1217,10 @@ class OnlineChecker:
                 self._results[name] = r
 
     def finalize(self, timeout_s: float | None = 600.0) -> dict:
-        """Stop the driver and return every finished target's result."""
+        """Stop the driver and return every finished target's result.
+        A crashed driver thread yields {'degraded': True, 'error': tb}
+        (no per-target verdicts) so the caller can log the degradation
+        and fall through to offline checking."""
         self._q.put(_SENTINEL)
         self._thread.join(timeout_s)
         if self._thread.is_alive():
@@ -979,7 +1228,11 @@ class OnlineChecker:
                         "abandoning it (offline checking still runs)",
                         timeout_s)
             return {}
-        return dict(self._results)
+        out = dict(self._results)
+        if self.driver_error is not None:
+            out["degraded"] = True
+            out["error"] = self.driver_error
+        return out
 
     def close(self) -> None:
         """Crash-path stop: don't wait for tail verification."""
@@ -1029,7 +1282,12 @@ def maybe_online(test: dict):
                             "sort"),
                     state_range=test.get("online-state-range"),
                     concurrency_hint=test.get("concurrency"),
-                    pallas=c.opts.get("pallas"))
+                    pallas=c.opts.get("pallas"),
+                    checkpoint_every=test.get(
+                        "online-checkpoint-every",
+                        DEFAULT_CHECKPOINT_EVERY),
+                    max_recovery_retries=test.get(
+                        "max-recovery-retries"))
             except (ValueError, ImportError) as e:
                 log.warning("online: linearizable target declined: %s",
                             e)

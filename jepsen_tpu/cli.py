@@ -110,6 +110,14 @@ def test_opt_spec() -> list[dict]:
             help="With --online: abort the run as soon as the "
                  "streaming checker confirms a nonlinearizable "
                  "prefix, saving the remaining cluster time."),
+        opt("--max-recovery-retries", type=int, default=None,
+            metavar="N",
+            help="Device-fault recovery budget for the checkers: a "
+                 "classified backend fault (OOM, device loss, compile "
+                 "failure, wedged sync) is absorbed and retried down "
+                 "the recovery ladder at most N times per checking "
+                 "entry before falling back to the host mirror "
+                 "(default 3)."),
     ]
 
 

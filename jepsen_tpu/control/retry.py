@@ -15,6 +15,12 @@ out; the cap bounds the worst-case wait.
 Commands that fail with a *nonzero exit status* are NOT retried — that's
 a real result, not transport trouble. Only transport-level exceptions
 trigger reconnect+retry.
+
+`backoff()` is also the delay schedule for the checkers' device-fault
+recovery ladders (wgl/_RecoveryTrail, streaming.WglStream): a TPU that
+just OOMed or dropped off the bus is the same shape of problem as a
+node whose sshd is drowning — N retriers hammering it in lockstep make
+it worse, decorrelated jitter spreads them out.
 """
 
 from __future__ import annotations

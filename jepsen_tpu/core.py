@@ -347,6 +347,27 @@ def log_results(test: dict) -> dict:
     err = results.get("error")
     log.info("%s%s\n\n%s", _pstr(results),
              f"\n\n{err}" if err else "", verdict)
+    # partial degradation (a checker exhausted its recovery ladder —
+    # its verdict is missing) is a different outcome from full
+    # recovery (every verdict present; the device faulted en route)
+    deg = results.get("degraded-checkers") or \
+        (["results"] if results.get("degraded") else [])
+    rec = results.get("recovered-checkers") or \
+        (["results"]
+         if isinstance(results.get("recovered"), dict) else [])
+    if deg:
+        log.warning("analysis DEGRADED: %s lost their device verdict "
+                    "to backend faults past the recovery budget",
+                    sorted(deg))
+    elif rec:
+        from . import report
+        detail = "; ".join(filter(None, (
+            report.recovery_line(results if k == "results"
+                                 else results.get(k))
+            for k in sorted(rec))))
+        log.info("analysis recovered from backend faults (%s); all "
+                 "verdicts are complete%s", sorted(rec),
+                 f" — {detail}" if detail else "")
     return test
 
 
@@ -461,8 +482,23 @@ def run(test: dict) -> dict:
                     streamed = oc.finalize()
                     if streamed:
                         done["streamed-results"] = streamed
-                        log.info("Online verification finished %s "
-                                 "during the run", sorted(streamed))
+                        finished = sorted(set(streamed)
+                                          - {"degraded", "error"})
+                        if streamed.get("degraded"):
+                            # targets WITH a streamed verdict keep it;
+                            # the crash cost the ones without, and the
+                            # offline re-check path covers exactly those
+                            lost = sorted(set(oc.targets) -
+                                          set(finished))
+                            log.warning(
+                                "Online checker degraded (driver "
+                                "crashed); falling through to the "
+                                "offline re-check path for %s",
+                                lost or "no targets (all verdicts "
+                                        "streamed before the crash)")
+                        else:
+                            log.info("Online verification finished %s "
+                                     "during the run", finished)
                     if oc.aborted:
                         done["aborted-on-violation"] = True
                 log.info("Run complete, writing")

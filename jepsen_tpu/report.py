@@ -24,6 +24,24 @@ class _Tee(io.TextIOBase):
             sink.flush()
 
 
+def recovery_line(results: dict) -> str:
+    """One printable line summarizing a result's device-fault recovery
+    trail, or '' when the result never faulted — for report `to`
+    blocks and the web results page."""
+    rec = (results or {}).get("recovered")
+    if not isinstance(rec, dict):
+        # workload checkers reuse the 'recovered' key for their own
+        # payloads (e.g. the set checker's recovered-element string);
+        # a device-fault trail is always a dict
+        return ""
+    line = (f"recovered from backend faults: "
+            f"{', '.join(rec.get('faults', []))} "
+            f"({rec.get('retries', 0)} retries")
+    if "resumed-from-chunk" in rec:
+        line += f", resumed from chunk {rec['resumed-from-chunk']}"
+    return line + ")"
+
+
 @contextlib.contextmanager
 def to(filename: str, tee: bool = True):
     """Context manager: stdout inside the block is written to filename

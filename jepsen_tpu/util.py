@@ -124,7 +124,7 @@ TIMED_OUT = _TimedOut()
 
 
 def timeout(seconds: float, fn: Callable[[], Any],
-            default: Any = Timeout) -> Any:
+            default: Any = Timeout, name: str | None = None) -> Any:
     """Run fn in a daemon worker thread; if it exceeds the deadline
     return ``default`` (or raise Timeout when no default is given).
 
@@ -136,7 +136,9 @@ def timeout(seconds: float, fn: Callable[[], Any],
     must therefore tolerate running to completion after their caller
     has moved on (idempotent teardown, no half-owned locks). Pass
     ``default=TIMED_OUT`` to get a sentinel distinct from anything fn
-    itself could return."""
+    itself could return. ``name`` labels the worker thread, so an
+    abandoned hang is attributable in a thread dump (the device-sync
+    watchdog names its guards after the sync site)."""
     box: list = []
 
     def run():
@@ -145,7 +147,7 @@ def timeout(seconds: float, fn: Callable[[], Any],
         except BaseException as e:  # noqa: BLE001
             box.append(("err", e))
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(target=run, daemon=True, name=name)
     t.start()
     t.join(seconds)
     if not box:

@@ -64,6 +64,20 @@ def _file_url(*components) -> str:
         "/files/" + "/".join(str(c) for c in components if c != ""))
 
 
+def recovery_note(r: dict) -> str:
+    """Validity-cell suffix when any checker result in the map carries
+    a device-fault trail: '(degraded)' lost a verdict to backend
+    faults, '(recovered)' faulted but resumed to a full verdict."""
+    subs = [r] + [v for v in r.values() if isinstance(v, dict)]
+    if any(s.get("degraded") for s in subs):
+        return " (degraded)"
+    # dict-typed only: workload checkers reuse 'recovered' for their
+    # own payloads (e.g. the set checker's recovered-element string)
+    if any(isinstance(s.get("recovered"), dict) for s in subs):
+        return " (recovered)"
+    return ""
+
+
 def test_row(t: dict) -> str:
     r = t.get("results") or {}
     u = _file_url(t["name"], t["start-time"])
@@ -73,7 +87,7 @@ def test_row(t: dict) -> str:
         f'<td><a href="{u}">{html.escape(t["name"])}</a></td>'
         f'<td><a href="{u}">{html.escape(t["start-time"])}</a></td>'
         f'<td style="background: {valid_color(valid)}">'
-        f'{html.escape(str(valid))}</td>'
+        f'{html.escape(str(valid) + recovery_note(r))}</td>'
         f'<td><a href="{u}/results.json">results.json</a></td>'
         f'<td><a href="{u}/history.jsonl.gz">history</a></td>'
         f'<td><a href="{u}/jepsen.log">jepsen.log</a></td>'

@@ -50,10 +50,14 @@ def test_check_safe_names_the_failing_checker():
 
 
 def test_check_safe_backend_runtime_error_reports_degraded():
-    """XLA/device failures surface as RuntimeError subclasses from jax;
-    they mean the device path fell over, not that the history has
-    anomalies — reported as 'degraded' so operators can tell the two
-    apart."""
+    """Device failures mean the device path fell over, not that the
+    history has anomalies — reported as 'degraded' with the
+    classifier's fault bucket so operators can tell the two apart.
+    jax raises backend-*init* failures as plain RuntimeErrors
+    (xla_bridge), so those exact signatures classify too; any other
+    plain RuntimeError is a checker bug and must NOT classify, even
+    with a device-looking message (tests/test_recovery.py pins the
+    full routing)."""
     def device_init_fails(test, hist, opts):
         raise RuntimeError("INTERNAL: failed to initialize TPU system")
     r = c.check_safe(device_init_fails, {}, History([]), {})
@@ -61,6 +65,12 @@ def test_check_safe_backend_runtime_error_reports_degraded():
     assert r["degraded"] is True
     assert r["checker"] == "device_init_fails"
     assert "initialize TPU" in r["error"]
+
+    def checker_bug(test, hist, opts):
+        raise RuntimeError("RESOURCE_EXHAUSTED: ran out of list items")
+    r = c.check_safe(checker_bug, {}, History([]), {})
+    assert r["valid?"] == c.UNKNOWN
+    assert "degraded" not in r
 
 
 def test_compose_attributes_failures_per_checker():
