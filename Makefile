@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test tier1 check
+.PHONY: lint test tier0 tier1 check
 
 lint:
 	$(PY) tools/lint.py
@@ -12,6 +12,13 @@ lint:
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# fast pre-gate: just the tier-1 screen + ABFT attestation suites
+# (seconds, no kernel compiles beyond the small fault matrices) — run
+# before the full tier-1 sweep so a broken screen/attestation layer
+# fails in the first minute, not the fortieth. CI runs this first.
+tier0:
+	$(PY) -m pytest tests/test_screen.py tests/test_attest.py -q
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
 # holds the larger shape sweeps, e.g. the pallas dedup parity sweep).

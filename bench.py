@@ -463,6 +463,122 @@ def section_recovery():
     }}
 
 
+def section_tiered():
+    """Tiered always-on verification (checker/screen.py + ABFT
+    attestation): tier-1 screening throughput on clean vs anomalous
+    histories, escalation rates over a labeled matrix (with the
+    no-false-negative check at the screen boundary: the screen must
+    escalate every history the full checker rejects), and the ABFT
+    checksum overhead vs unguarded kernels."""
+    import os as _os
+
+    from jepsen_tpu.checker import screen, synth
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
+
+    # -- labeled matrix: clean + anomalous registers ------------------
+    # smoke-scale runs (orchestrator tests, BENCH_N_OPS overridden
+    # down) keep this section DEVICE-FREE: screen throughput and
+    # escalation rates only — the full-checker cross-validation and
+    # the ABFT A/B each cost cold kernel compiles that would dominate
+    # a smoke round, and both are pinned directly in tier-1
+    # (tests/test_screen.py's no-false-negative matrix,
+    # tests/test_attest.py's bitflip matrix)
+    smoke = N_OPS < DEFAULT_N_OPS // 4
+    n = max(N_OPS // 10, 300)
+    seeds = (13, 21) if smoke else (13, 21, 7, 45100)
+    clean = [synth.register_history(n, concurrency=CONCURRENCY,
+                                    values=5, seed=s)
+             for s in seeds]
+    anomalous = [synth.corrupt(h, seed=i + 3)
+                 for i, h in enumerate(clean)]
+
+    # -- tier-1 screening throughput ----------------------------------
+    # same shape as the headline section (crash_rate matters: the
+    # default 2% pins ~N/50 slots forever, forcing the P=64 sort
+    # family — the adversarial section's job, not this one's)
+    big = synth.register_history(N_OPS, concurrency=CONCURRENCY,
+                                 values=5, crash_rate=0.0005,
+                                 seed=45100)
+    best_clean, sc_big = _best_of(
+        lambda: screen.screen_history(model, big))
+    big_bad = synth.corrupt(big, seed=5)
+    best_bad, sc_bad = _best_of(
+        lambda: screen.screen_history(model, big_bad))
+    assert sc_big["valid?"] is True and sc_bad["valid?"] is False
+
+    # -- escalation rate + screen-boundary soundness ------------------
+    matrix = [(h, True) for h in clean] + [(h, False) for h in anomalous]
+    escalations = {"clean": 0, "anomalous": 0}
+    false_negatives: int | None = 0 if not smoke else None
+    for h, is_clean in matrix:
+        sc = screen.screen_history(model, h)
+        price = screen.price_escalation(model, h)
+        esc, _why = screen.should_escalate(
+            sc, sample=screen.DEFAULT_SAMPLE,
+            cost=price["cost"] if price else None)
+        escalations["clean" if is_clean else "anomalous"] += bool(esc)
+        if smoke:
+            continue
+        # explain=False: the matrix needs verdicts, not blame
+        # certificates — the host explain re-search on each anomalous
+        # member would dominate the section
+        full = analysis_tpu(model, h, budget_s=120, explain=False)
+        if full["valid?"] is False and not esc:
+            false_negatives += 1
+    assert not false_negatives, \
+        f"screen passed {false_negatives} histories the full checker " \
+        f"rejects"
+
+    # -- ABFT checksum overhead vs unguarded kernels ------------------
+    # flip the env gate (resolved outside the kernel caches) and use a
+    # chunked run so the carry-digest boundary cost is included
+    abft: dict = {"skipped": "smoke scale"}
+    if not smoke:
+        prev = _os.environ.get("JEPSEN_TPU_ATTEST")
+        try:
+            _os.environ["JEPSEN_TPU_ATTEST"] = "1"
+            analysis_tpu(model, big, chunk_entries=1024)   # warm
+            best_on, a_on = _best_of(
+                lambda: analysis_tpu(model, big, chunk_entries=1024))
+            assert a_on.get("attested"), "guarded run must attest"
+            _os.environ["JEPSEN_TPU_ATTEST"] = "0"
+            analysis_tpu(model, big, chunk_entries=1024)   # warm
+            best_off, a_off = _best_of(
+                lambda: analysis_tpu(model, big, chunk_entries=1024))
+            assert a_on["valid?"] == a_off["valid?"] is True
+            abft = {
+                "guarded_s": round(best_on, 3),
+                "unguarded_s": round(best_off, 3),
+                "overhead_pct": round(
+                    100.0 * (best_on - best_off)
+                    / max(best_off, 1e-6), 2),
+                "attested": a_on.get("attested"),
+                "engine": a_on["analyzer"],
+            }
+        finally:
+            if prev is None:
+                _os.environ.pop("JEPSEN_TPU_ATTEST", None)
+            else:
+                _os.environ["JEPSEN_TPU_ATTEST"] = prev
+
+    return {"tiered": {
+        "screen_ops_per_s_clean": round(N_OPS / max(best_clean, 1e-6),
+                                        1),
+        "screen_ops_per_s_anomalous": round(
+            N_OPS / max(best_bad, 1e-6), 1),
+        "matrix": {"clean": len(clean), "anomalous": len(anomalous),
+                   "ops_each": n},
+        "escalation_rate_clean": round(
+            escalations["clean"] / len(clean), 3),
+        "escalation_rate_anomalous": round(
+            escalations["anomalous"] / len(anomalous), 3),
+        "screen_false_negatives": false_negatives,
+        "sample_fraction": screen.DEFAULT_SAMPLE,
+        "abft": abft}}
+
+
 def section_config1():
     """Tutorial-scale 200-op register (CPU parity target)."""
     from jepsen_tpu.checker import synth
@@ -670,6 +786,7 @@ SECTIONS = [
     ("adversarial", section_adversarial, 600 + HOST_BUDGET_S, True),
     ("streaming", section_streaming, 900, True),
     ("recovery", section_recovery, 900, True),
+    ("tiered", section_tiered, 600, True),
     ("config1", section_config1, 420, True),
     ("config2", section_config2, 480, True),
     ("config3", section_config3, 600, True),

@@ -34,15 +34,21 @@ import os
 # Fault buckets (classify_backend_error return values). Anything the
 # classifier recognizes as a backend failure but cannot place more
 # precisely lands in FAULT_WEDGED — the "wedged-other" rung, handled
-# with a plain bounded retry.
+# with a plain bounded retry. FAULT_CORRUPT is raised by the checkers
+# THEMSELVES (checker/abft.py): an ABFT checksum mismatch means a
+# staged buffer or device result was silently corrupted — the rung is
+# a re-stage/replay from canonical host data.
 FAULT_OOM = "oom"
 FAULT_DEVICE_LOST = "device-lost"
 FAULT_COMPILE = "compile"
 FAULT_WEDGED = "wedged"
-FAULT_KINDS = (FAULT_OOM, FAULT_DEVICE_LOST, FAULT_COMPILE, FAULT_WEDGED)
+FAULT_CORRUPT = "corrupt"
+FAULT_KINDS = (FAULT_OOM, FAULT_DEVICE_LOST, FAULT_COMPILE,
+               FAULT_WEDGED, FAULT_CORRUPT)
 
 FAULT_INJECT_ENV = "JEPSEN_TPU_FAULT_INJECT"
 SYNC_DEADLINE_ENV = "JEPSEN_TPU_SYNC_DEADLINE_S"
+ATTEST_ENV = "JEPSEN_TPU_ATTEST"
 
 
 class InjectedFault(RuntimeError):
@@ -56,6 +62,26 @@ class InjectedFault(RuntimeError):
         super().__init__(
             f"injected {kind} fault at {site} dispatch #{seq}")
         self.kind = kind
+
+
+class CorruptDeviceResult(RuntimeError):
+    """An ABFT attestation checksum disagreed: a staged buffer, a
+    device reduction, or a fetched carry was silently corrupted
+    (bit-flip in HBM / on the transfer path / in a compute unit).
+
+    Classified FAULT_CORRUPT so the recovery ladders treat silent
+    corruption like any other backend fault: re-stage from canonical
+    host data (offline/batch/sharded), or restore the last carry
+    checkpoint and replay the host-side steps log (stream) — the
+    resumed verdict is identical to an uncorrupted run's, instead of
+    confidently wrong."""
+
+    kind = FAULT_CORRUPT
+
+    def __init__(self, site: str, detail: str):
+        super().__init__(
+            f"attestation mismatch at {site}: {detail}")
+        self.site = site
 
 
 class WedgedDeviceSync(RuntimeError):
@@ -177,13 +203,27 @@ def backend_reinit() -> None:
 # Checked on every maybe_inject_fault call, before the env knob.
 fault_hook = None
 
+# Monkeypatchable hook around staging: fn(site, arr) -> ndarray | None
+# (None = leave the buffer alone). Checked on every maybe_corrupt
+# call, before the env knob — the bitflip analog of fault_hook, for
+# corruption schedules the env spec can't express.
+corrupt_hook = None
+
+# the deterministic bit a bitflip clause flips (bit 12 of the middle
+# element): any single flipped bit is detected by the attestation
+# digests, and a fixed site keeps the injected corruption reproducible
+BITFLIP_KIND = "bitflip"
+_BITFLIP_BIT = 12
+
 _fault_seq: dict[str, int] = {}
+_corrupt_seq: dict[str, int] = {}
 
 
 def reset_fault_injection() -> None:
-    """Zero the per-site dispatch counters (each test starts its own
-    deterministic injection schedule)."""
+    """Zero the per-site dispatch/staging counters (each test starts
+    its own deterministic injection schedule)."""
     _fault_seq.clear()
+    _corrupt_seq.clear()
 
 
 def maybe_inject_fault(site: str) -> None:
@@ -191,12 +231,15 @@ def maybe_inject_fault(site: str) -> None:
 
     Sites in use: 'offline' (wgl.analysis_tpu), 'batch'
     (wgl.analysis_tpu_batch), 'sharded' (wgl.check_batch_sharded),
-    'stream-chunk' (streaming.WglStream). The env spec is a
+    'stream-chunk' (streaming.WglStream), 'elle'
+    (elle.kernels._classify_batches). The env spec is a
     comma-separated list of ``kind@site:n`` clauses; the n-th dispatch
     on a matching site raises InjectedFault(kind) (n is 1-based and
     counts every dispatch since reset_fault_injection(), so a
     recovery retry advances the counter past the clause — the fault
-    fires once, like a real transient)."""
+    fires once, like a real transient). ``bitflip`` clauses never
+    raise here — they corrupt staged buffers via maybe_corrupt, on a
+    separate per-site staging counter."""
     n = _fault_seq.get(site, 0) + 1
     _fault_seq[site] = n
     hook = fault_hook
@@ -210,9 +253,72 @@ def maybe_inject_fault(site: str) -> None:
         if not clause:
             continue
         kind, _, rest = clause.partition("@")
+        if kind == BITFLIP_KIND:
+            continue   # silent-corruption clauses act at staging time
         tsite, _, seq = rest.partition(":")
         if tsite == site and n == int(seq or 1):
             raise InjectedFault(kind, site, n)
+
+
+def maybe_corrupt(site: str, arr):
+    """Called on each host-staged device buffer right before it ships.
+
+    A ``bitflip@site:n`` clause in JEPSEN_TPU_FAULT_INJECT flips one
+    bit (_BITFLIP_BIT of the middle element) in a COPY of the n-th
+    staged buffer on that site — the caller ships the returned array
+    while its canonical host copy (and therefore the attestation
+    digest it computes from it) stays intact, exactly the shape of a
+    silent DMA/HBM bit-flip. n counts stagings since
+    reset_fault_injection(), so a recovery retry's re-stage advances
+    past the clause and ships clean data, like a real transient.
+    corrupt_hook(site, arr) -> ndarray|None is checked first, for
+    schedules the env spec can't express. Returns the array to ship
+    (the original object when nothing matched: zero-copy)."""
+    n = _corrupt_seq.get(site, 0) + 1
+    _corrupt_seq[site] = n
+    hook = corrupt_hook
+    if hook is not None:
+        out = hook(site, arr)
+        if out is not None:
+            return out
+    spec = os.environ.get(FAULT_INJECT_ENV)
+    if not spec:
+        return arr
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, _, rest = clause.partition("@")
+        if kind != BITFLIP_KIND:
+            continue
+        tsite, _, seq = rest.partition(":")
+        if tsite == site and n == int(seq or 1):
+            return flip_bit(arr)
+    return arr
+
+
+def flip_bit(arr, bit: int = _BITFLIP_BIT):
+    """A copy of arr with one bit flipped in its middle element (the
+    deterministic corruption bitflip clauses inject)."""
+    import numpy as np
+
+    out = np.array(arr, copy=True)
+    flat = out.reshape(-1).view(np.uint32 if out.dtype.itemsize == 4
+                                else np.uint8)
+    flat[len(flat) // 2] ^= np.uint32(1 << bit) if flat.dtype.itemsize \
+        == 4 else np.uint8(1 << (bit % 8))
+    return out
+
+
+def attest_enabled(override=None) -> bool:
+    """Is ABFT attestation on? An explicit checker option beats the
+    JEPSEN_TPU_ATTEST env gate (default ON — always-on verification is
+    the point; =0 opts out, e.g. to measure the unguarded baseline).
+    Resolved outside the kernel caches so flipping it mid-process
+    takes effect on the next call."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get(ATTEST_ENV, "1") != "0"
 
 
 # ---------------------------------------------------------------------------

@@ -142,7 +142,14 @@ class Compose(Checker):
     full recovery), 'degraded-checkers' names those that lost their
     verdict to faults past the recovery budget (partial degradation).
     The two are distinct outcomes: a recovered composition is
-    complete, a degraded one is missing answers."""
+    complete, a degraded one is missing answers.
+
+    Tiered-verification outcomes are summarized the same way:
+    'screened-checkers' names sub-checkers whose verdict came from the
+    tier-1 O(n) screen alone, 'escalated-checkers' those the screen
+    escalated to a full search, and 'attested-checkers' those whose
+    device results carried (and passed) ABFT attestation. Older
+    stored results without these fields summarize to nothing."""
 
     def __init__(self, checker_map: Mapping[str, Any]):
         self.checkers = {k: coerce(c) for k, c in checker_map.items()}
@@ -169,6 +176,21 @@ class Compose(Checker):
             out["recovered-checkers"] = recovered
         if degraded:
             out["degraded-checkers"] = degraded
+        screened = sorted(k for k, r in results
+                          if isinstance(r, dict) and r.get("screened")
+                          and not r.get("escalated"))
+        escalated = sorted(k for k, r in results
+                           if isinstance(r, dict)
+                           and isinstance(r.get("escalated"), dict))
+        attested = sorted(k for k, r in results
+                          if isinstance(r, dict)
+                          and isinstance(r.get("attested"), dict))
+        if screened:
+            out["screened-checkers"] = screened
+        if escalated:
+            out["escalated-checkers"] = escalated
+        if attested:
+            out["attested-checkers"] = attested
         return out
 
 

@@ -62,7 +62,14 @@ class ListAppendChecker(Checker):
 class RWRegisterChecker(Checker):
     """Checker adapter over wr.check (reference
     `tests/cycle/wr.clj:14-54`; `:additional-graphs` per its lines
-    17-26)."""
+    17-26).
+
+    Honors the test map's 'tier' knob (CLI --tier): at tier 'screen'
+    the O(n) WrScreen (single-pass anomalies + exact SCC cycle
+    existence — see checker/screen.py) decides whether the full
+    classification/certificate search runs at all. Checkers with
+    additional precedence graphs always run the full search: the
+    screen's SCC pass covers only the dependency edges."""
 
     def __init__(self, anomalies=("G0", "G1", "G2"), mesh=None,
                  additional_graphs=()):
@@ -72,6 +79,39 @@ class RWRegisterChecker(Checker):
         self.additional_graphs = tuple(additional_graphs)
 
     def check(self, test, hist, opts):
+        from .. import screen as _screen
+        if _screen.tier_is_screen((test or {}).get("tier")) \
+                and not self.additional_graphs:
+            return self._tier1(test, hist)
+        return self._full_check(test, hist)
+
+    def _tier1(self, test, hist):
+        from .. import screen as _screen
+        sc = self._streamed_screen(test, hist) \
+            or _screen.screen_wr(hist, anomalies=self.anomalies)
+        sample = (test or {}).get("screen-sample")
+        if sample is None:
+            sample = _screen.DEFAULT_SAMPLE
+        esc, why = _screen.should_escalate(sc, sample=float(sample))
+        if not esc:
+            out = dict(sc)
+            out["tier"] = 1
+            return out
+        full = self._full_check(test, hist)
+        full["escalated"] = _screen.escalation_record(sc, why)
+        full["tier"] = 1
+        return full
+
+    def _streamed_screen(self, test, hist):
+        r = ((test or {}).get("streamed-results") or {}) \
+            .get("screen-wr")
+        if not r or not r.get("screened"):
+            return None
+        if r.get("history-len") != len(_history(hist).client_ops()):
+            return None
+        return dict(r)
+
+    def _full_check(self, test, hist):
         # a result the online pipeline already streamed during the run
         # (checker/streaming.WrStream) is reused instead of rebuilding
         # the graph — guarded on covering the same history AND asking

@@ -264,13 +264,25 @@ class Linearizable(Checker):
                      call granularity, and wall-clock budget
       max_recovery_retries int — device-fault recovery budget: how
                      many classified backend faults (OOM / device
-                     lost / compile / wedged) the entry absorbs and
-                     retries before taking its final rung (host
-                     mirror under the size cap). Defaults to
+                     lost / compile / wedged / corrupt) the entry
+                     absorbs and retries before taking its final rung
+                     (host mirror under the size cap). Defaults to
                      wgl.MAX_RECOVERY_RETRIES; the test map's
                      'max-recovery-retries' (CLI
                      --max-recovery-retries) applies when the option
                      is unset here.
+      tier='full'|'screen'|1 — tiered verification (checker/screen.py).
+                     'screen' runs the O(n) invariant screen first and
+                     the full search only on suspicion or a sampled
+                     fraction; a screen pass returns a screened
+                     verdict, an escalated result carries 'escalated'
+                     with the screen's suspicion and the cost-model
+                     pricing. The test map's 'tier' (CLI --tier)
+                     applies when unset here.
+      screen_sample float — sampled-escalation fraction for clean
+                     histories at tier 1 (default
+                     screen.DEFAULT_SAMPLE; test map 'screen-sample' /
+                     CLI --screen-sample).
 
     e.g. ``linearizable({'model': m, 'engine': 'dense',
     'budget_s': 120})`` or ``linearizable(m, dense_slot_cap=12,
@@ -288,6 +300,55 @@ class Linearizable(Checker):
         self.opts = opts
 
     def check(self, test, hist, opts):
+        from . import screen as _screen
+        tier = self.opts.get("tier", (test or {}).get("tier"))
+        if _screen.tier_is_screen(tier):
+            return self._tier1(test, hist, opts)
+        return self._full_check(test, hist, opts)
+
+    def _tier1(self, test, hist, opts):
+        """The tiered pipeline: O(n) screen every history; run the
+        full device search only on suspicion or a deterministic
+        sampled fraction, priced through wgl.select_engine's cost
+        model. See checker/screen.py for the screen's invariants and
+        soundness posture."""
+        from . import screen as _screen
+        sc = self._streamed_screen(test, hist) \
+            or _screen.screen_history(self.model, hist)
+        price = _screen.price_escalation(self.model, hist)
+        sample = self.opts.get("screen_sample")
+        if sample is None:
+            sample = (test or {}).get("screen-sample")
+        if sample is None:
+            sample = _screen.DEFAULT_SAMPLE
+        esc, why = _screen.should_escalate(
+            sc, sample=float(sample),
+            cost=price["cost"] if price else None)
+        if not esc:
+            out = dict(sc)
+            out["tier"] = 1
+            return out
+        full = self._full_check(test, hist, opts)
+        full["escalated"] = _screen.escalation_record(sc, why, price)
+        full["tier"] = 1
+        return full
+
+    def _streamed_screen(self, test, hist) -> dict | None:
+        """A screen verdict the online pipeline already produced
+        (maybe_online's 'screen-linear' target) — reused under the
+        same coverage guards as _streamed_result."""
+        r = ((test or {}).get("streamed-results") or {}) \
+            .get("screen-linear")
+        if not r or not r.get("screened"):
+            return None
+        if r.get("model") != repr(self.model):
+            return None
+        if r.get("history-len") != \
+                len(as_history(hist).client_ops()):
+            return None
+        return dict(r)
+
+    def _full_check(self, test, hist, opts):
         streamed = self._streamed_result(test, hist)
         if streamed is not None:
             # same post-processing as an offline verdict: a definite
@@ -306,6 +367,8 @@ class Linearizable(Checker):
         if algo not in ("auto", "tpu", "host", "competition"):
             raise ValueError(f"unknown linearizability algorithm {algo!r}")
         kw = dict(self.opts)
+        kw.pop("tier", None)           # tier knobs are this checker's,
+        kw.pop("screen_sample", None)  # not the device engine's
         mrr = (test or {}).get("max-recovery-retries")
         if mrr is not None:
             kw.setdefault("max_recovery_retries", mrr)

@@ -62,7 +62,8 @@ from typing import Any, Callable
 import numpy as np
 
 from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST, FAULT_OOM,
-                         guarded_device_get, maybe_inject_fault)
+                         attest_enabled, guarded_device_get,
+                         maybe_corrupt, maybe_inject_fault)
 from ..history import (KIND_INFO, KIND_OK, NIL, PENDING_RET,
                        DeviceEncodingError, History, OpArray,
                        history as as_history)
@@ -368,6 +369,15 @@ class WglStream:
         self._rows_done = 0       # step rows the device has consumed
         self._resumed_from_chunk: int | None = None
         self._last_fault: BaseException | None = None
+        # ABFT attestation (JEPSEN_TPU_ATTEST, default on): each
+        # staged chunk's device digest is held here and verified
+        # against the host digest at the NEXT chunk boundary (the
+        # lagged liveness sync / a checkpoint / finish), so detection
+        # adds no extra sync; carry digests verify at checkpoints.
+        self._attest = attest_enabled()
+        self._att_pending: list[tuple] = []   # (device digest, expected)
+        self._att_steps = 0
+        self._att_carry = 0
 
     @property
     def faults(self) -> list:
@@ -469,6 +479,10 @@ class WglStream:
 
         self._k = None
         self._setup()
+        # digests enqueued by the failed attempt reference dead
+        # dispatches; the replay below re-stages (and re-attests)
+        # every slice past the checkpoint
+        self._att_pending = []
         if self._ckpt is not None:
             rows0, chunks0, host = self._ckpt
             self._carry = tuple(jnp.asarray(a) for a in host)
@@ -504,11 +518,18 @@ class WglStream:
             # in-flight async chunk
             buf = np.repeat(self._pad_row[None], self.chunk, axis=0)
             buf[:len(sl)] = sl
+            xj = jnp.asarray(maybe_corrupt("stream-chunk", buf))
+            if self._attest:
+                from . import abft
+                self._att_pending.append(
+                    (abft.digest_device(xj), abft.digest_host(buf)))
             self._carry = self._k.check_stream_chunk(
-                jnp.asarray(buf), jnp.int32(len(sl)), self._carry)
+                xj, jnp.int32(len(sl)), self._carry)
             self._chunks += 1
             self._rows_done += len(sl)
             self._maybe_checkpoint()
+        if self._attest:
+            self._drain_attest()
         if not self._dead:
             self._check_death(self._carry)
         log.info("online WGL stream resumed from chunk %d "
@@ -523,7 +544,22 @@ class WglStream:
         if not self.checkpoint_every \
                 or self._chunks % self.checkpoint_every:
             return
-        host = guarded_device_get(self._carry, site="stream checkpoint")
+        if self._attest:
+            # a checkpoint must be KNOWN GOOD before it becomes the
+            # recovery target: verify every staged chunk that fed it,
+            # then fetch the carry together with its device digest and
+            # cross-check on host — corruption detected here falls
+            # back to the PREVIOUS checkpoint
+            from . import abft
+            self._drain_attest()
+            host, hd = guarded_device_get(
+                (self._carry, self._k.digest(self._carry)),
+                site="stream checkpoint")
+            abft.verify_carry("stream-chunk", hd, host)
+            self._att_carry += 1
+        else:
+            host = guarded_device_get(self._carry,
+                                      site="stream checkpoint")
         self._ckpt = (self._rows_done, self._chunks, host)
 
     def _recovering(self, fn: Callable[[], Any], site: str,
@@ -614,6 +650,7 @@ class WglStream:
         self.encoder = StreamEncoder(self.dm.codec, self.dm.droppable, p)
         self._k = None
         self._steps_log = []
+        self._att_pending = []
         self._chunks = 0
         # a rebuild replaces the kernel family/shape: the old carry
         # checkpoint no longer matches and the steps log restarts
@@ -690,8 +727,17 @@ class WglStream:
         if n < self.chunk:
             buf[n:] = self._pad_row
         prev = self._carry
+        xj = jnp.asarray(maybe_corrupt("stream-chunk", buf))
+        if self._attest:
+            # enqueue the shipped buffer's device digest; the host
+            # digest comes from the canonical staging buffer BEFORE it
+            # is reused. Verified lagged (at _drain_attest callers) so
+            # the chunk pipeline keeps its one sync per chunk.
+            from . import abft
+            self._att_pending.append(
+                (abft.digest_device(xj), abft.digest_host(buf)))
         self._carry = self._k.check_stream_chunk(
-            jnp.asarray(buf), jnp.int32(n), self._carry)
+            xj, jnp.int32(n), self._carry)
         self._chunks += 1
         self._rows_done += n
         if not self._dead:
@@ -702,9 +748,35 @@ class WglStream:
             self._check_death(prev)
         self._maybe_checkpoint()
 
+    def _drain_attest(self) -> None:
+        """Verify every pending staged-buffer digest (raises
+        CorruptDeviceResult on a mismatch — callers run under the
+        recovery ladder, which restores the last checkpoint and
+        replays the canonical steps log)."""
+        while self._att_pending:
+            d, exp = self._att_pending[0]
+            from . import abft
+            abft.verify_steps(
+                "stream-chunk",
+                guarded_device_get(d, site="stream attest"), exp)
+            self._att_pending.pop(0)
+            self._att_steps += 1
+
     def _check_death(self, carry) -> None:
-        ok, _death, overflow, _maxc = guarded_device_get(
-            self._k.summarize(carry), site="stream liveness")
+        # ONE fetch per chunk, as designed: the pending staged-buffer
+        # digests ride the liveness sync instead of paying their own
+        # round-trips, and summarize's att output covers the in-kernel
+        # invariants at the same boundary
+        from . import abft
+        pend, self._att_pending = self._att_pending, []
+        summary, digs = guarded_device_get(
+            (self._k.summarize(carry), [d for d, _ in pend]),
+            site="stream liveness")
+        ok, _death, overflow, _maxc, att = summary
+        for dv, (_, exp) in zip(digs, pend):
+            abft.verify_steps("stream-chunk", dv, exp)
+            self._att_steps += 1
+        _wgl._check_att(att, "stream-chunk")
         self._chunk_syncs += 1
         if not bool(ok):
             self._dead = True
@@ -774,13 +846,18 @@ class WglStream:
         def _settle():
             if self._k is None:
                 self._setup()   # zero-op run: still produce a verdict
-            return guarded_device_get(
+            if self._attest:
+                self._drain_attest()
+            out = guarded_device_get(
                 self._k.summarize(self._carry), site="stream summarize")
+            _wgl._check_att(out[-1], "stream-chunk")
+            return out
 
         settled = self._recovering(_settle, "summarize")
         if settled is None:
             return None   # budget spent; offline checking covers
-        ok, death, overflow, max_count = settled
+        ok, death, overflow, max_count, att = settled
+        del att   # _settle already checked it (nonzero raised there)
         ok, overflow = bool(ok), bool(overflow)
         F = self.frontier
         all_steps = (np.concatenate(self._steps_log)
@@ -796,14 +873,18 @@ class WglStream:
                 k2 = _wgl._kernel(self.name, F, self.p, self.chunk,
                                   self._pack, pallas=self.pallas)
                 carry = self._replay(all_steps, k2)
-                return k2, guarded_device_get(
+                out = guarded_device_get(
                     k2.summarize(carry), site="stream escalate")
+                # inside the closure so a corrupt att re-runs under
+                # the same recovery ladder as any other fault here
+                _wgl._check_att(out[-1], "stream-chunk")
+                return k2, out
 
             esc = self._recovering(_escalate, "escalate",
                                    restore=False)
             if esc is None:
                 return None
-            k2, (ok, death, overflow, max_count) = esc
+            k2, (ok, death, overflow, max_count, _att2) = esc
             ok, overflow = bool(ok), bool(overflow)
             self._k = k2
             # keep the stream's frontier in lockstep with the kernel:
@@ -834,6 +915,9 @@ class WglStream:
             "configs": [],
             "final-paths": [],
         }
+        if self._attest:
+            out["attested"] = {"steps": self._att_steps,
+                               "carry": self._att_carry}
         if self.faults:
             rec = {"faults": list(self.faults),
                    "retries": len(self.faults)}
@@ -874,7 +958,7 @@ class WglStream:
             log.warning("online blame replay abandoned after backend "
                         "faults; verdict kept without a culprit op")
             return
-        ok, death, _ovf, _maxc = r
+        ok, death, *_rest = r
         d = int(death)
         if bool(ok) or d < 0:
             return
@@ -1259,14 +1343,29 @@ def maybe_online(test: dict):
     truthy), wiring a stream target per recognized checker: the first
     Linearizable with a device-form model (key 'linear') and the first
     RWRegisterChecker without additional graphs (key 'elle-wr').
+    At tier 'screen' (test['tier'], CLI --tier) the O(n) tier-1
+    screens additionally run over the live journal feed
+    ('screen-linear' / 'screen-wr' — host-side, model-agnostic
+    enough to cover checkers the device streams decline), and their
+    verdicts are what the tiered checkers reuse at analyze time.
     Returns None when the test declined or nothing is streamable."""
     if not test.get("online"):
         return None
+    from . import screen as _screen
     from .elle import RWRegisterChecker
     from .linear import Linearizable
 
     targets: dict[str, Any] = {}
+    tiered = _screen.tier_is_screen(test.get("tier"))
     for c in _walk_checkers(test.get("checker")):
+        if tiered and isinstance(c, Linearizable) \
+                and "screen-linear" not in targets:
+            targets["screen-linear"] = _screen.ScreenStream(c.model)
+        if tiered and isinstance(c, RWRegisterChecker) \
+                and not c.additional_graphs \
+                and "screen-wr" not in targets:
+            targets["screen-wr"] = _screen.WrScreen(
+                anomalies=c.anomalies)
         if isinstance(c, Linearizable) and "linear" not in targets:
             if c.model.device_model is None or c.algorithm == "host":
                 continue

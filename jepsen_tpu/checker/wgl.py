@@ -59,9 +59,10 @@ from typing import Callable
 
 import numpy as np
 
-from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST, FAULT_OOM,
-                         backend_reinit, classify_backend_error,
-                         guarded_device_get, maybe_inject_fault)
+from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST,
+                         FAULT_OOM, attest_enabled, backend_reinit,
+                         classify_backend_error, guarded_device_get,
+                         maybe_corrupt, maybe_inject_fault)
 from ..history import (DeviceEncodingError, F_CAS, F_READ, F_WRITE,
                        KIND_OK, NIL, OpArray, default_register_codec,
                        encode_ops, history as as_history)
@@ -481,7 +482,37 @@ def _bucket(n: int, lo: int = 64) -> int:
 
 Kernel = collections.namedtuple(
     "Kernel", ["check", "check_batch", "check_chunk", "check_chunk_batch",
-               "check_stream_chunk", "init_carry", "summarize"])
+               "check_stream_chunk", "init_carry", "summarize", "digest"])
+
+
+def _mk_digest():
+    """Build the jitted carry digest: xor-fold of (component wrap-sum *
+    prime_i) over the carry elements in order — the host mirror is
+    abft.carry_digest_host, which must stay in lockstep. Verified at
+    the chunk boundaries where the carry is fetched anyway (stream
+    checkpoints, offline summarize): a mismatch means the carry
+    changed between the device's reduction and the fetch."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import abft
+
+    i32 = jnp.int32
+
+    @jax.jit
+    def digest(carry):
+        h = i32(0)
+        for i, c in enumerate(carry):
+            c = jnp.asarray(c)
+            if c.dtype == jnp.uint32:
+                ci = lax.bitcast_convert_type(c, i32)
+            else:
+                ci = c.astype(i32)
+            h = h ^ (jnp.sum(ci, dtype=i32) * i32(abft.prime_i32(i)))
+        return h
+
+    return digest
 
 
 def _pack_params(state_range: tuple[int, int] | None,
@@ -555,7 +586,8 @@ def _kernel(model_name: str, F: int, P: int, E: int,
     into a cached kernel — the same contract as _dense_kernel."""
     use_dedup, on_tpu = _pallas_enabled("JEPSEN_TPU_PALLAS_DEDUP",
                                         pallas)
-    return _kernel_cached(model_name, F, P, E, pack, use_dedup, on_tpu)
+    return _kernel_cached(model_name, F, P, E, pack, use_dedup, on_tpu,
+                          attest_enabled())
 
 
 def _clear_sort_caches():
@@ -571,10 +603,17 @@ _kernel.cache_clear = _clear_sort_caches
 @functools.lru_cache(maxsize=32)
 def _kernel_cached(model_name: str, F: int, P: int, E: int,
                    pack: tuple[int, int] | None,
-                   use_dedup: bool, on_tpu: bool):
+                   use_dedup: bool, on_tpu: bool,
+                   use_attest: bool = True):
     """Build the jitted checker for a (model, frontier-size, slots,
     entry-capacity) shape. Returns fn(entry arrays..., n_entries) ->
     (ok, death_entry, overflow, max_frontier).
+
+    use_attest: accumulate ABFT self-check residues in the carry's
+    ``att`` element (see the attestation comment on init_carry) —
+    resolved from JEPSEN_TPU_ATTEST outside the cache like the pallas
+    gates. The att element is ALWAYS present (uniform carry shape for
+    checkpoints either way); only the accumulation is gated.
 
     pack: (s_lo, sb_bits) from _pack_params. When the whole config
     (invalid flag, biased state, P-bit pending mask) fits one uint32,
@@ -638,7 +677,8 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
             .astype(i32) + s_lo
         valid_f = valid_s[:F]
         new_f = valid_f & (org_s[:F] == 1)
-        return masks_f, states_f, valid_f, new_f, valid_f.sum(), overflow
+        return masks_f, states_f, valid_f, new_f, valid_f.sum(), \
+            overflow, i32(0)
 
     def dedup_hash(masks, states, valid):
         """Sort-free dedup: the packed 31-bit config key goes through
@@ -648,25 +688,44 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
         at both call sites, so first-seen-wins is exactly the stable
         sort's old-configs-first rule and `new` needs no origin lane.
         The frontier is set-equal to the sort path's — downstream is
-        order-invariant, so verdicts/summaries/blame are identical."""
+        order-invariant, so verdicts/summaries/blame are identical.
+
+        ABFT: the pallas kernel also emits its table-occupancy XOR
+        digest (xor of claimed keys ^ count mix). When the distinct
+        count fits the frontier the same value is recomputed here from
+        the compacted OUTPUT (a different store path), and any
+        disagreement — a flipped VMEM word, a dropped or
+        double-claimed key — is returned as `mism` for the caller's
+        att accumulator."""
         key = jnp.where(
             valid,
             ((states - s_lo) << P) | masks[:, 0].astype(i32),
             i32(-1))
-        out_keys, new_f, distinct = hash_dedup(len(key))(key)
+        out_keys, new_f, distinct, kdig = hash_dedup(len(key))(key)
         valid_f = out_keys >= 0
         safe = jnp.where(valid_f, out_keys, 0)
         masks_f = (safe & ((1 << P) - 1)).astype(u32)[:, None]
         states_f = (safe >> P) + s_lo
+        if use_attest:
+            from .wgl_dedup import DIGEST_COUNT_MIX
+            exp = lax.reduce(jnp.where(valid_f, safe, 0), i32(0),
+                             lax.bitwise_xor, (0,))
+            exp = exp ^ (distinct * i32(DIGEST_COUNT_MIX))
+            mism = ((exp != kdig) & (distinct <= F)).astype(i32)
+        else:
+            mism = i32(0)
         return masks_f, states_f, valid_f, new_f & valid_f, \
-            valid_f.sum(), distinct > F
+            valid_f.sum(), distinct > F, mism
 
     def dedup(masks, states, valid, origin):
         """Sort (N,)-rows lexicographically by (invalid, mask words, state);
         mark duplicate keys invalid (stable sort + old-configs-first makes
         the original config win); truncate to F.
 
-        Returns (masks[F,W], states[F], valid[F], new[F], count, overflow).
+        Returns (masks[F,W], states[F], valid[F], new[F], count,
+        overflow, mism) — mism is the hash path's digest-mismatch flag
+        (always 0 for the sort variants, whose output IS the sorted
+        input: there is no second store path to cross-check).
         """
         if hash_dedup is not None:
             return dedup_hash(masks, states, valid)
@@ -687,18 +746,19 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
         states_f = st_s[:F]
         valid_f = valid_s[:F]
         new_f = valid_f & (org_s[:F] == 1)
-        return masks_f, states_f, valid_f, new_f, valid_f.sum(), overflow
+        return masks_f, states_f, valid_f, new_f, valid_f.sum(), \
+            overflow, i32(0)
 
     def expand_full(masks, states, valid, new, slot_f, slot_a, slot_b,
-                    slot_occ, overflow):
+                    slot_occ, overflow, att):
         """Stage B: close the frontier under linearization, expanding only
         from freshly-added configs each round."""
 
         def cond(c):
-            return c[3].any() & ~c[5]  # any new configs & not converged
+            return c[3].any() & ~c[6]  # any new configs & not converged
 
         def body(c):
-            masks, states, valid, new, overflow, _ = c
+            masks, states, valid, new, overflow, att, _ = c
             # candidates: new configs x all pending slots
             legal, cstate = step(states[:, None], slot_f[None, :],
                                  slot_a[None, :], slot_b[None, :])
@@ -718,38 +778,53 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
                 all_valid = jnp.concatenate([valid, cvalid])
                 origin = jnp.concatenate(
                     [jnp.zeros(F, jnp.bool_), jnp.ones(F * P, jnp.bool_)])
-                m2, s2, v2, n2, cnt2, ovf2 = dedup(
+                m2, s2, v2, n2, cnt2, ovf2, mism = dedup(
                     all_masks, all_states, all_valid, origin)
                 grew = n2.any()
-                return m2, s2, v2, n2, overflow | ovf2, ~grew
+                return m2, s2, v2, n2, overflow | ovf2, att + mism, \
+                    ~grew
 
             def no_sort(_):
                 # Derive constants from varying operands so both cond
                 # branches carry the same manual-axes tags under shard_map.
                 return masks, states, valid, \
-                    valid & False, overflow, any_legal | True
+                    valid & False, overflow, att, any_legal | True
 
             return lax.cond(any_legal, do_sort, no_sort, None)
 
-        masks, states, valid, new, overflow, _ = lax.while_loop(
-            cond, body, (masks, states, valid, new, overflow,
+        masks, states, valid, new, overflow, att, _ = lax.while_loop(
+            cond, body, (masks, states, valid, new, overflow, att,
                          jnp.bool_(False)))
-        return masks, states, valid, overflow
+        return masks, states, valid, overflow, att
 
     def init_carry(init_state):
+        # carry layout: (e, masks, states, valid, slot_f, slot_a,
+        # slot_b, slot_occ, overflow, att, count, max_count). att is
+        # the ABFT attestation accumulator — in-loop invariant
+        # residues (valid configs holding bits of unoccupied slots,
+        # hash-dedup digest mismatches) sum into it and it must read 0
+        # on host at every chunk boundary (abft.verify_carry); the
+        # element is present even with attestation off so carry
+        # checkpoints keep one shape.
         masks0 = jnp.zeros((F, W), u32)
         states0 = jnp.full((F,), init_state, i32)
         valid0 = jnp.zeros((F,), jnp.bool_).at[0].set(True)
         return (i32(0), masks0, states0, valid0,
                 jnp.zeros((P,), i32), jnp.full((P,), NIL, i32),
                 jnp.full((P,), NIL, i32), jnp.zeros((P,), jnp.bool_),
-                jnp.bool_(False), i32(1), i32(1))
+                jnp.bool_(False), i32(0), i32(1), i32(1))
 
     def summarize(carry):
-        (e, _m, _s, _valid, *_slots, overflow, count, max_count) = carry
+        # att rides along as the 5th output so EVERY verdict fetch —
+        # fused single-call, batch, sharded, stream liveness/finish —
+        # sees the in-kernel attestation accumulator, not only the
+        # boundaries that fetch the whole carry (_check_att raises
+        # on a nonzero value at each consumer)
+        (e, _m, _s, _valid, *_slots, overflow, att, count,
+         max_count) = carry
         ok = count > 0
         death = jnp.where(ok, i32(-1), e - 1)
-        return ok, death, overflow, max_count
+        return ok, death, overflow, max_count, att
 
     def run_range(x, stop, carry):
         """Advance the search from carry's position up to step `stop`
@@ -759,7 +834,7 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
         long searches (the carry round-trips through host memory)."""
         def invoke_phase(s, f, a, b, args):
             masks, states, valid, slot_f, slot_a, slot_b, slot_occ, \
-                overflow = args
+                overflow, att = args
             slot_f = slot_f.at[s].set(f)
             slot_a = slot_a.at[s].set(a)
             slot_b = slot_b.at[s].set(b)
@@ -773,22 +848,23 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
             all_valid = jnp.concatenate([valid, cvalid])
             origin = jnp.concatenate(
                 [jnp.zeros(F, jnp.bool_), jnp.ones(F, jnp.bool_)])
-            masks, states, valid, new, _, ovf = dedup(
+            masks, states, valid, new, _, ovf, mism = dedup(
                 all_masks, all_states, all_valid, origin)
             overflow = overflow | ovf
+            att = att + mism
             # stage B: chase enabled chains
-            masks, states, valid, overflow = expand_full(
+            masks, states, valid, overflow, att = expand_full(
                 masks, states, valid, new, slot_f, slot_a, slot_b,
-                slot_occ, overflow)
+                slot_occ, overflow, att)
             return masks, states, valid, slot_f, slot_a, slot_b, \
-                slot_occ, overflow
+                slot_occ, overflow, att
 
         def cond(c):
-            return (c[0] < stop) & (c[9] > 0)
+            return (c[0] < stop) & (c[10] > 0)
 
         def body(c):
             (e, masks, states, valid, slot_f, slot_a, slot_b, slot_occ,
-             overflow, count, max_count) = c
+             overflow, att, count, max_count) = c
             row = x[e]
             rm = lax.bitcast_convert_type(row[:W], u32)        # (W,)
             s, f, a, b = row[W], row[W + 1], row[W + 2], row[W + 3]
@@ -802,15 +878,28 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
             slot_occ = slot_occ & ~(BITMAT & rm[None, :]) \
                 .astype(jnp.bool_).any(axis=1)
             (masks, states, valid, slot_f, slot_a, slot_b, slot_occ,
-             overflow) = lax.cond(
+             overflow, att) = lax.cond(
                 s >= 0,
                 lambda args: invoke_phase(s, f, a, b, args),
                 lambda args: args,
                 (masks, states, valid, slot_f, slot_a, slot_b, slot_occ,
-                 overflow))
+                 overflow, att))
+            if use_attest:
+                # ABFT frontier invariant: a valid configuration may
+                # only hold pending bits of OCCUPIED slots (completion
+                # clears freed slots from every mask; invoke occupies
+                # before setting). A bit-flip in masks/valid/slot_occ
+                # violates this with high probability; residues sum
+                # into att and are checked host-side at chunk
+                # boundaries. Cost: one (F, W) mask op per step.
+                occw = jnp.sum(
+                    jnp.where(slot_occ[:, None], BITMAT,
+                              jnp.zeros_like(BITMAT)), axis=0)   # (W,)
+                bad = valid & ((masks & ~occw[None, :]) != 0).any(axis=1)
+                att = att + bad.sum().astype(i32)
             count = valid.sum().astype(i32)
             return (e + 1, masks, states, valid, slot_f, slot_a, slot_b,
-                    slot_occ, overflow, count,
+                    slot_occ, overflow, att, count,
                     jnp.maximum(max_count, count))
 
         return lax.while_loop(cond, body, carry)
@@ -846,7 +935,8 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
         return (out[0] + carry[0],) + tuple(out[1:])
 
     return Kernel(check, check_batch, check_chunk, check_chunk_batch,
-                  check_stream_chunk, init_carry, summarize)
+                  check_stream_chunk, init_carry, summarize,
+                  _mk_digest())
 
 
 # ---------------------------------------------------------------------------
@@ -888,7 +978,7 @@ def _dense_kernel(model_name: str, s_lo: int, S: int, P: int, E: int,
     use_pallas, on_tpu = _pallas_enabled("JEPSEN_TPU_PALLAS_CLOSURE",
                                          pallas)
     return _dense_kernel_cached(model_name, s_lo, S, P, E,
-                                use_pallas, on_tpu)
+                                use_pallas, on_tpu, attest_enabled())
 
 
 def _clear_dense_caches():
@@ -903,7 +993,8 @@ _dense_kernel.cache_clear = _clear_dense_caches
 
 @functools.lru_cache(maxsize=32)
 def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
-                         E: int, use_pallas: bool, on_tpu: bool):
+                         E: int, use_pallas: bool, on_tpu: bool,
+                         use_attest: bool = True):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -994,20 +1085,27 @@ def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
         return table
 
     def init_carry(init_state):
+        # carry layout: (e, table, slot_f, slot_a, slot_b, slot_occ,
+        # att, count, max_count) — att is the ABFT attestation
+        # accumulator (see the sort kernel's twin): table-occupancy
+        # invariant residues sum into it and it must read 0 on host
+        # at every chunk boundary (abft.verify_carry).
         table = jnp.zeros((S, C), jnp.bool_)
         table = table.at[init_state - s_lo, 0].set(True)
         return (i32(0), table,
                 jnp.zeros((P,), i32), jnp.full((P,), NIL, i32),
                 jnp.full((P,), NIL, i32), jnp.zeros((P,), jnp.bool_),
-                i32(1), i32(1))
+                i32(0), i32(1), i32(1))
 
     def summarize(carry):
-        e, table, *_slots, count, max_count = carry
+        # att as the 5th output — see the sort kernel's twin
+        (e, table, _sf, _sa, _sb, _occ, att, count,
+         max_count) = carry
         ok = count > 0
         death = jnp.where(ok, i32(-1), e - 1)
         # the dense table never drops configurations: overflow is
         # impossible and every verdict is exact
-        return ok, death, jnp.bool_(False), max_count
+        return ok, death, jnp.bool_(False), max_count, att
 
     def run_range(x, stop, carry):
         def invoke_phase(s, f, a, b, args):
@@ -1020,10 +1118,11 @@ def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
             return table, slot_f, slot_a, slot_b, slot_occ
 
         def cond(c):
-            return (c[0] < stop) & (c[6] > 0)
+            return (c[0] < stop) & (c[7] > 0)
 
         def body(c):
-            e, table, slot_f, slot_a, slot_b, slot_occ, count, maxc = c
+            (e, table, slot_f, slot_a, slot_b, slot_occ, att, count,
+             maxc) = c
             row = x[e]
             # the dense table caps P well below 31, so the completion
             # mask fits a non-negative int32 — no bitcast needed
@@ -1043,9 +1142,22 @@ def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
                 lambda args: invoke_phase(s, f, a, b, args),
                 lambda args: args,
                 (table, slot_f, slot_a, slot_b, slot_occ))
+            if use_attest:
+                # ABFT table invariant: a configuration column whose
+                # mask holds a bit of an UNOCCUPIED slot is
+                # unreachable (completions gather those columns away;
+                # the closure only sets occupied bits) — any true cell
+                # there is a bit-flip. Cost: one (S, C) mask-and-sum
+                # per step, the same shape as the count reduction.
+                occ_bits = jnp.sum(
+                    jnp.where(slot_occ, 1 << ARANGE_P,
+                              jnp.zeros_like(ARANGE_P)),
+                    dtype=i32)
+                badc = (COLS & ~occ_bits) != 0                  # (C,)
+                att = att + jnp.sum(table & badc[None, :], dtype=i32)
             count = table.sum().astype(i32)
             return (e + 1, table, slot_f, slot_a, slot_b, slot_occ,
-                    count, jnp.maximum(maxc, count))
+                    att, count, jnp.maximum(maxc, count))
 
         return lax.while_loop(cond, body, carry)
 
@@ -1076,7 +1188,8 @@ def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
         return (out[0] + carry[0],) + tuple(out[1:])
 
     return Kernel(check, check_batch, check_chunk, check_chunk_batch,
-                  check_stream_chunk, init_carry, summarize)
+                  check_stream_chunk, init_carry, summarize,
+                  _mk_digest())
 
 
 DENSE_STATE_CAP = 512  # closure() is O(P * S^2 * C): bound S too
@@ -1305,6 +1418,10 @@ def _apply_recovery_rung(kind: str, kw: dict) -> None:
         _device_reinit()
     elif kind == FAULT_COMPILE:
         kw["pallas"] = False
+    # FAULT_CORRUPT (an ABFT attestation mismatch) needs no knob
+    # mutation: the retry re-stages every device buffer from canonical
+    # host data, which IS the rung — like FAULT_WEDGED, a plain
+    # bounded retry
 
 
 def _device_reinit() -> None:
@@ -1488,7 +1605,20 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
     # shares this compiled kernel
     E = _bucket(max(event_count(ops), 1))
     steps = steps.pad_to(E)
-    x = jnp.asarray(steps.x)
+    # ABFT staged-buffer attestation: ship (possibly bitflip-injected)
+    # data, then compare a device-side digest of the shipped buffer
+    # with the host digest of the canonical one — corruption on the
+    # staging/DMA path raises CorruptDeviceResult, which the recovery
+    # ladder absorbs by re-staging from the canonical host copy.
+    attest_on = attest_enabled()
+    x = jnp.asarray(maybe_corrupt("offline", steps.x))
+    att_info = None
+    if attest_on:
+        from . import abft
+        abft.verify_steps("offline", guarded_device_get(
+            abft.digest_device(x), site="offline attest"),
+            abft.digest_host(steps.x))
+        att_info = {"steps": 1, "carry": 0}
     init_state = jnp.int32(model.device_state())
     F = frontier
     timed_out = cancelled = False
@@ -1502,9 +1632,10 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
         if steps.n <= chunk_entries:
             # single fused call: init + full search + verdict
             maybe_inject_fault("offline")
-            ok, death, overflow, max_count = guarded_device_get(
+            ok, death, overflow, max_count, att = guarded_device_get(
                 k.check(x, jnp.int32(steps.n), init_state),
                 site="offline check")
+            _check_att(att, "offline")
         else:
             carry = k.init_carry(init_state)
             # Pipelined chunk loop: enqueue chunk i (dispatch is async),
@@ -1541,8 +1672,20 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
                         timed_out = True
                         cancelled = stop_req and not over
                         break
-            ok, death, overflow, max_count = guarded_device_get(
+            if attest_on:
+                # chunk-boundary carry attestation: fetch the carry
+                # with its device-computed digest, recompute on host,
+                # and check the structural invariants (att == 0) —
+                # silent corruption of the frontier in HBM or on the
+                # fetch path surfaces here instead of in the verdict
+                from . import abft
+                hc, hd = guarded_device_get(
+                    (carry, k.digest(carry)), site="offline attest")
+                abft.verify_carry("offline", hd, hc)
+                att_info["carry"] += 1
+            ok, death, overflow, max_count, att = guarded_device_get(
                 k.summarize(carry), site="offline summarize")
+            _check_att(att, "offline")
         ok = bool(ok) and not timed_out
         overflow = bool(overflow) or timed_out
         if ok or not overflow or F >= max_frontier or timed_out:
@@ -1568,6 +1711,8 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
         "configs": [],
         "final-paths": [],
     }
+    if att_info is not None:
+        out["attested"] = att_info
     if not ok:
         if cancelled:
             out["error"] = "search cancelled (competition loser)"
@@ -1608,7 +1753,7 @@ def _death_row(k: Kernel, ops: OpArray, slots: int, E: int,
     import jax.numpy as jnp
 
     steps = build_steps(ops, slots, merge=False).pad_to(E)
-    ok, death, _, _ = guarded_device_get(
+    ok, death, *_ = guarded_device_get(
         k.check(jnp.asarray(steps.x), jnp.int32(steps.n), init_state),
         site="offline blame")
     d = int(death)
@@ -1659,6 +1804,20 @@ def _dense_caps_error(srange, p: int, key=None) -> ValueError:
     return ValueError(
         f"dense engine requested but {who} {srange} state range x "
         f"2^{p} table exceeds the dense caps")
+
+
+def _check_att(att, site: str) -> None:
+    """Raise the corrupt fault when a fetched attestation accumulator
+    is nonzero — an in-kernel invariant (frontier/table occupancy,
+    hash-dedup digest) failed on device. att is constant 0 when
+    attestation is disabled, so the check is unconditional."""
+    a = int(np.asarray(att))
+    if a != 0:
+        from .._platform import CorruptDeviceResult
+        raise CorruptDeviceResult(
+            site, f"in-kernel attestation accumulator = {a} — a "
+                  f"frontier/table invariant or dedup digest failed "
+                  f"on device")
 
 
 def _unknown_result(ops, error: str, t0: float) -> dict:
@@ -1968,7 +2127,16 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
         else:
             k = _kernel(name, frontier, slots, E,
                         _pack_params(srange, slots), pallas=pallas)
-        x = jnp.asarray(np.stack([st.x for st in padded]))
+        x_np = np.stack([st.x for st in padded])
+        attest_on = attest_enabled()
+        x = jnp.asarray(maybe_corrupt("batch", x_np))
+        if attest_on:
+            # staged-buffer attestation (see the offline twin): the
+            # whole vmapped stack ships as one buffer, one digest
+            from . import abft
+            abft.verify_steps("batch", guarded_device_get(
+                abft.digest_device(x), site="batch attest"),
+                abft.digest_host(x_np))
         ns = np.asarray([st.n for st in padded], np.int32)
         s0 = jnp.full(len(padded), model.device_state(), jnp.int32)
         carry = jax.vmap(k.init_carry)(s0)
@@ -1995,8 +2163,18 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
                         and _time.monotonic() - t0 > budget_s) \
                         or (cancel is not None and cancel()):
                     break
-        ok, death, overflow, max_count = guarded_device_get(
+        if attest_on:
+            # per-key carry attestation at the batch's final boundary
+            from . import abft
+            hc, hd = guarded_device_get(
+                (carry, jax.vmap(k.digest)(carry)), site="batch attest")
+            for bi in range(len(np.asarray(hd))):
+                abft.verify_carry(
+                    "batch", np.asarray(hd)[bi],
+                    tuple(np.asarray(a)[bi] for a in hc))
+        ok, death, overflow, max_count, att = guarded_device_get(
             jax.vmap(k.summarize)(carry), site="batch summarize")
+        _check_att(np.asarray(att).sum(), "batch")
         counts = np.asarray(carry[-2])
         batch_dedup = (DEDUP_NONE if dense is not None else
                        dedup_engine(frontier, slots,
@@ -2028,7 +2206,7 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
             # unmerged streams fit E by construction)
             st2s = [build_steps(ops, slots, merge=False).pad_to(E)
                     for _, _, ops in invalids]
-            okb, deathb, _, _ = guarded_device_get(k.check_batch(
+            okb, deathb, *_ = guarded_device_get(k.check_batch(
                 jnp.asarray(np.stack([s.x for s in st2s])),
                 jnp.asarray(np.asarray([s.n for s in st2s], np.int32)),
                 jnp.full(len(st2s), model.device_state(), jnp.int32)))
@@ -2065,6 +2243,11 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
                         ops, f"frontier overflowed at {frontier}; "
                         f"escalation cap {max_frontier} reached — "
                         "verdict unknown", t0)
+        if attest_on:
+            for i, _ops, _st in items:
+                r = results[i]
+                if isinstance(r, dict):
+                    r.setdefault("attested", {"steps": 1, "carry": 1})
     dur = (_time.monotonic() - t0) * 1e3
     for r in results:
         if r is not None:
@@ -2098,12 +2281,14 @@ def _sharded_runner(name, dense, frontier, slots, srange, E, mesh, axis,
         use_pallas, on_tpu = _pallas_enabled(
             "JEPSEN_TPU_PALLAS_DEDUP", pallas)
     return _sharded_runner_cached(name, dense, frontier, slots, srange,
-                                  E, mesh, axis, use_pallas, on_tpu)
+                                  E, mesh, axis, use_pallas, on_tpu,
+                                  attest_enabled())
 
 
 @functools.lru_cache(maxsize=256)
 def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
-                           mesh, axis, use_pallas, on_tpu):
+                           mesh, axis, use_pallas, on_tpu,
+                           use_attest=True):
     import jax
     from functools import partial
     from jax.sharding import PartitionSpec as P
@@ -2111,11 +2296,12 @@ def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
     if dense is not None:
         check_batch = _dense_kernel_cached(
             name, dense[0], dense[1], dense[2], E,
-            use_pallas, on_tpu).check_batch
+            use_pallas, on_tpu, use_attest).check_batch
     else:
         check_batch = _kernel_cached(name, frontier, slots, E,
                                      _pack_params(srange, slots),
-                                     use_pallas, on_tpu).check_batch
+                                     use_pallas, on_tpu,
+                                     use_attest).check_batch
 
     # check_vma=False: the kernel's inner lax loops create fresh constants
     # whose varying-manual-axes tags can't match the sharded carries; the
@@ -2128,13 +2314,16 @@ def _sharded_runner_cached(name, dense, frontier, slots, srange, E,
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis)),
-             out_specs=(P(), P(axis), P(axis)))
+             out_specs=(P(), P(axis), P(axis), P()))
     def run(x, n, s0):
-        ok, death, overflow, max_count = check_batch(x, n, s0)
+        ok, death, overflow, max_count, att = check_batch(x, n, s0)
         # every shard's verdict, reduced over ICI: 1 iff all keys valid
         bad = (~ok).sum()
         total_bad = jax.lax.psum(bad, axis)
-        return (total_bad == 0)[None], ok, overflow
+        # attestation accumulators reduced the same way: the host
+        # checks one scalar per group instead of gathering per-key atts
+        total_att = jax.lax.psum(att.sum(), axis)
+        return (total_bad == 0)[None], ok, overflow, total_att[None]
 
     return jax.jit(run)
 
@@ -2338,17 +2527,28 @@ def _check_batch_sharded_once(model, hists: list, mesh=None,
         run = _sharded_runner(name, dense, frontier, g_slots, srange,
                               E, mesh, axis, pallas=pallas)
         maybe_inject_fault("sharded")
+        x_np = np.stack([st.x for st in padded])
+        xj = jnp.asarray(maybe_corrupt("sharded", x_np))
+        # staged-buffer attestation: the digest reduction runs on the
+        # SAME device buffer the sharded kernel consumes; its scalar
+        # is fetched with the group's verdicts below, so detection
+        # costs no extra sync
+        att = None
+        if attest_on:
+            from . import abft
+            att = (abft.digest_device(xj), abft.digest_host(x_np))
         # async dispatch: return the device arrays unfetched so every
         # group's kernel is enqueued before the first blocking fetch —
         # on a remote relay each synchronous fetch is a full
         # round-trip, so serializing dispatch+fetch per group would
         # re-add the latency the grouping saved
-        all_ok_g, ok_g, ov_g = run(
-            jnp.asarray(np.stack([st.x for st in padded])),
+        all_ok_g, ok_g, ov_g, att_g = run(
+            xj,
             jnp.asarray(np.asarray([st.n for st in padded], np.int32)),
             jnp.asarray(np.full(g_pad, model.device_state(), np.int32)))
-        return all_ok_g, ok_g, ov_g
+        return all_ok_g, ok_g, ov_g, att_g, att
 
+    attest_on = attest_enabled()
     pending = [(idx, run_group(idx, d))
                for d, idx in (dense_groups[pg]
                               for pg in sorted(dense_groups))]
@@ -2358,8 +2558,12 @@ def _check_batch_sharded_once(model, hists: list, mesh=None,
     overflow = np.zeros(k, bool)
     all_ok = True
     for idx, handles in pending:
-        all_ok_g, ok_g, ov_g = guarded_device_get(
+        all_ok_g, ok_g, ov_g, att_g, att = guarded_device_get(
             handles, site="sharded fetch")
+        _check_att(np.asarray(att_g)[0], "sharded")
+        if att is not None:
+            from . import abft
+            abft.verify_steps("sharded", att[0], att[1])
         all_ok &= bool(np.asarray(all_ok_g)[0])
         per_key[idx] = np.asarray(ok_g)[:len(idx)]
         overflow[idx] = np.asarray(ov_g)[:len(idx)]
@@ -2381,5 +2585,11 @@ def _check_batch_sharded_once(model, hists: list, mesh=None,
             per_key[i] = subs[t]["valid?"] is True
         all_ok = bool(per_key.all())
     if return_info:
-        return all_ok, per_key, {"groups": group_info}
+        info = {"groups": group_info}
+        if attest_on:
+            # steps: one staged-buffer digest per group; carry: one
+            # psum-reduced att check per group (see _sharded_runner)
+            info["attested"] = {"steps": len(pending),
+                                "carry": len(pending)}
+        return all_ok, per_key, info
     return all_ok, per_key

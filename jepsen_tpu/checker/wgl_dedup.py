@@ -95,7 +95,7 @@ def compiles() -> bool:
             import numpy as np
 
             fn = dedup_fn(8, 4, interpret=False)
-            out, _new, cnt = fn(np.arange(8, dtype=np.int32))
+            out, _new, cnt, _dig = fn(np.arange(8, dtype=np.int32))
             _PROBE = int(cnt) == 8 and list(map(int, out)) == [0, 1, 2, 3]
         except Exception:   # Mosaic lowering/compile failure
             _PROBE = False
@@ -114,13 +114,31 @@ def eligible(F: int, P: int) -> bool:
     return vmem <= MAX_VMEM_BYTES
 
 
+# digest mixing constant (golden-ratio prime): the occupancy count is
+# folded into the XOR digest so a dropped-and-double-counted key pair
+# (XOR-cancelling) still perturbs the digest
+DIGEST_COUNT_MIX = -1640531527   # 0x9E3779B9 as int32
+
+
 @functools.lru_cache(maxsize=32)
 def dedup_fn(N: int, F: int, interpret: bool = False):
     """Build `dedup(keys (N,) int32) -> (out_keys (F,), new (F,),
-    count ())` — distinct valid keys in first-seen order, compacted;
-    `new[i]` set when out_keys[i] was first seen at input index >= F;
-    `count` is the TOTAL distinct-valid count (count > F <=> the sort
-    path's overflow).  Slots past min(count, F) hold EMPTY."""
+    count (), digest ())` — distinct valid keys in first-seen order,
+    compacted; `new[i]` set when out_keys[i] was first seen at input
+    index >= F; `count` is the TOTAL distinct-valid count (count > F
+    <=> the sort path's overflow).  Slots past min(count, F) hold
+    EMPTY.
+
+    `digest` is the kernel's ABFT self-attestation: the XOR of every
+    key CLAIMED IN THE HASH TABLE, mixed with the occupancy count
+    (digest = xor(inserted keys) ^ (count * DIGEST_COUNT_MIX)).  When
+    the frontier did not overflow (count <= F) the caller can
+    recompute the same value from the compacted output alone
+    (wgl.dedup_hash does, folding any mismatch into the carry's att
+    accumulator): table and output are written by different store
+    paths, so a silent flip in either VMEM buffer — or a probe loop
+    miscompare that drops/double-claims a key — makes the two digests
+    disagree."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -141,12 +159,13 @@ def dedup_fn(N: int, F: int, interpret: bool = False):
         return h & i32(H - 1)
 
     def kernel(keys_ref, out_keys_ref, out_new_ref, count_ref,
-               table_ref):
+               digest_ref, table_ref):
         table_ref[:] = jnp.full((H, 1), EMPTY, i32)
         out_keys_ref[:] = jnp.full((F, 1), EMPTY, i32)
         out_new_ref[:] = jnp.zeros((F, 1), i32)
 
-        def insert(i, count):
+        def insert(i, carry):
+            count, dig = carry
             k = keys_ref[i, 0]
 
             def probe(state):
@@ -178,24 +197,30 @@ def dedup_fn(N: int, F: int, interpret: bool = False):
                 out_new_ref[count, 0] = jnp.where(i >= F, i32(1),
                                                   i32(0))
 
-            return count + fresh.astype(i32)
+            return (count + fresh.astype(i32),
+                    jnp.where(fresh, dig ^ k, dig))
 
-        count_ref[0, 0] = lax.fori_loop(0, N, insert, i32(0))
+        count, dig = lax.fori_loop(0, N, insert, (i32(0), i32(0)))
+        count_ref[0, 0] = count
+        digest_ref[0, 0] = dig ^ (count * i32(DIGEST_COUNT_MIX))
 
     @jax.jit
     def dedup(keys):
-        out_keys, out_new, count = pl.pallas_call(
+        out_keys, out_new, count, digest = pl.pallas_call(
             kernel,
             out_shape=(jax.ShapeDtypeStruct((F, 1), jnp.int32),
                        jax.ShapeDtypeStruct((F, 1), jnp.int32),
+                       jax.ShapeDtypeStruct((1, 1), jnp.int32),
                        jax.ShapeDtypeStruct((1, 1), jnp.int32)),
             in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                       pl.BlockSpec(memory_space=pltpu.VMEM),
                        pl.BlockSpec(memory_space=pltpu.VMEM),
                        pl.BlockSpec(memory_space=pltpu.VMEM)),
             scratch_shapes=[pltpu.VMEM((H, 1), jnp.int32)],
             interpret=interpret,
         )(keys.reshape(N, 1).astype(jnp.int32))
-        return out_keys[:, 0], out_new[:, 0] != 0, count[0, 0]
+        return (out_keys[:, 0], out_new[:, 0] != 0, count[0, 0],
+                digest[0, 0])
 
     return dedup

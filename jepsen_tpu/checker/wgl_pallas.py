@@ -23,6 +23,16 @@ interpret mode and by an on-hardware (S, P) shape-matrix sweep.
 Eligibility: the mask axis must fill the 128-lane tile (P >= 7), the
 padded state axis must be a multiple of 8, and the working set must
 fit VMEM (see MAX_VMEM_BYTES).
+
+Attestation contract: this kernel needs no digest of its own (unlike
+the hash-dedup kernel's table/output cross-check) because its output
+IS the dense carry table, which the enclosing dense kernel guards
+every step — the table-occupancy invariant in `wgl._dense_kernel`
+(no true cell in a column holding an unoccupied slot's bit) sums
+residues into the carry's `att` element, and `abft.verify_carry`
+checks att == 0 and count == popcount(table) at every chunk boundary.
+A closure round that silently corrupts the table is therefore caught
+at the same host boundaries as an XLA-formulation fault.
 """
 
 from __future__ import annotations

@@ -114,10 +114,24 @@ def test_opt_spec() -> list[dict]:
             metavar="N",
             help="Device-fault recovery budget for the checkers: a "
                  "classified backend fault (OOM, device loss, compile "
-                 "failure, wedged sync) is absorbed and retried down "
-                 "the recovery ladder at most N times per checking "
-                 "entry before falling back to the host mirror "
-                 "(default 3)."),
+                 "failure, wedged sync, attestation corruption) is "
+                 "absorbed and retried down the recovery ladder at "
+                 "most N times per checking entry before falling back "
+                 "to the host mirror (default 3)."),
+        opt("--tier", default=None, choices=["full", "screen"],
+            help="Verification tier: 'screen' runs the O(n) "
+                 "invariant screen over every history and escalates "
+                 "to the full WGL/Elle device search only on "
+                 "suspicion or a sampled fraction (see "
+                 "--screen-sample); 'full' (default) always runs the "
+                 "full search."),
+        opt("--screen-sample", type=float, default=None,
+            metavar="FRACTION",
+            help="With --tier screen: the fraction of clean "
+                 "(suspicion-free) histories that still escalate to "
+                 "a full check, auditing the screen's blind spots "
+                 "(default 0.05; scaled down for histories whose "
+                 "modeled full-check cost is high)."),
     ]
 
 

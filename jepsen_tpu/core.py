@@ -368,6 +368,28 @@ def log_results(test: dict) -> dict:
         log.info("analysis recovered from backend faults (%s); all "
                  "verdicts are complete%s", sorted(rec),
                  f" — {detail}" if detail else "")
+    # tiered verification: which verdicts came from the O(n) screen
+    # alone vs escalated to the full search, and which device results
+    # carried (passing) ABFT attestation
+    scr = results.get("screened-checkers") or \
+        (["results"] if results.get("screened")
+         and not results.get("escalated") else [])
+    esc = results.get("escalated-checkers") or \
+        (["results"]
+         if isinstance(results.get("escalated"), dict) else [])
+    att = results.get("attested-checkers") or \
+        (["results"]
+         if isinstance(results.get("attested"), dict) else [])
+    if scr or esc:
+        from . import report
+        detail = "; ".join(filter(None, (
+            report.tier_line(results if k == "results"
+                             else results.get(k))
+            for k in sorted(set(scr) | set(esc)))))
+        log.info("tier-1 verification: %d screened, %d escalated%s",
+                 len(scr), len(esc), f" — {detail}" if detail else "")
+    if att:
+        log.info("ABFT attestation passed on %s", sorted(att))
     return test
 
 

@@ -42,6 +42,27 @@ def recovery_line(results: dict) -> str:
     return line + ")"
 
 
+def tier_line(results: dict) -> str:
+    """One printable line summarizing a result's tiered-verification
+    outcome, or '' when the result never went through tier 1 (older
+    stored results included)."""
+    r = results or {}
+    esc = r.get("escalated")
+    if isinstance(esc, dict):
+        line = (f"tier-1 screen escalated ({esc.get('why', '?')}, "
+                f"suspicion {esc.get('suspicion', 0):g}) to the full "
+                f"checker")
+        eng = esc.get("engine")
+        if isinstance(eng, dict) and eng.get("family"):
+            line += (f" [{eng['family']}, modeled cost "
+                     f"{eng.get('cost', 0):.3g}]")
+        return line
+    if r.get("screened"):
+        return (f"tier-1 screen passed (suspicion "
+                f"{r.get('suspicion', 0):g}, no escalation)")
+    return ""
+
+
 @contextlib.contextmanager
 def to(filename: str, tee: bool = True):
     """Context manager: stdout inside the block is written to filename

@@ -66,8 +66,11 @@ def _file_url(*components) -> str:
 
 def recovery_note(r: dict) -> str:
     """Validity-cell suffix when any checker result in the map carries
-    a device-fault trail: '(degraded)' lost a verdict to backend
-    faults, '(recovered)' faulted but resumed to a full verdict."""
+    a device-fault or tier-1 trail: '(degraded)' lost a verdict to
+    backend faults, '(recovered)' faulted but resumed to a full
+    verdict, '(escalated)' the tier-1 screen triggered a full check,
+    '(screened)' the verdict came from the O(n) screen alone. Older
+    stored results without these fields get no suffix."""
     subs = [r] + [v for v in r.values() if isinstance(v, dict)]
     if any(s.get("degraded") for s in subs):
         return " (degraded)"
@@ -75,6 +78,10 @@ def recovery_note(r: dict) -> str:
     # own payloads (e.g. the set checker's recovered-element string)
     if any(isinstance(s.get("recovered"), dict) for s in subs):
         return " (recovered)"
+    if any(isinstance(s.get("escalated"), dict) for s in subs):
+        return " (escalated)"
+    if any(s.get("screened") for s in subs):
+        return " (screened)"
     return ""
 
 

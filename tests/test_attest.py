@@ -1,0 +1,291 @@
+"""ABFT attestation: silent-corruption detection + recovery.
+
+The contract under test (checker/abft.py, checker/wgl.py,
+checker/streaming.py, _platform.py): with
+``JEPSEN_TPU_FAULT_INJECT=bitflip@site:n`` armed, every attested
+device entry — offline, batch, sharded, stream-chunk, elle — detects
+the corrupted staged buffer via digest mismatch, classifies it as the
+``corrupt`` fault kind, and the recovery ladder re-stages/replays so
+the verdict is identical to an uninjected run's. Shapes are shared
+with tests/test_recovery.py (chunk 128, 8 slots, seed-13 histories)
+so tier-0/tier-1 pay each kernel compile once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jepsen_tpu._platform as plat
+import jepsen_tpu.control.retry as retry
+from jepsen_tpu import models
+from jepsen_tpu.checker import abft, streaming, synth, wgl
+
+MODEL = models.cas_register()
+CHUNK = 128
+SLOTS = 8
+
+
+@pytest.fixture(autouse=True)
+def _fast_deterministic_faults(monkeypatch):
+    monkeypatch.setattr(retry, "backoff",
+                        lambda *a, **k: iter([0.0] * 1000))
+    monkeypatch.delenv(plat.FAULT_INJECT_ENV, raising=False)
+    monkeypatch.delenv(plat.ATTEST_ENV, raising=False)
+    plat.reset_fault_injection()
+    yield
+    plat.fault_hook = None
+    plat.corrupt_hook = None
+    plat.reset_fault_injection()
+
+
+def _hist(seed=13, n=400, conc=4):
+    return synth.register_history(n, concurrency=conc, values=5,
+                                  seed=seed)
+
+
+# -- the injection shim -----------------------------------------------------
+
+def test_bitflip_clause_corrupts_nth_staging_once(monkeypatch):
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@s:2")
+    a = np.arange(16, dtype=np.int32)
+    assert plat.maybe_corrupt("s", a) is a          # staging 1: clean
+    b = plat.maybe_corrupt("s", a)                  # staging 2: flipped
+    assert b is not a and (b != a).sum() == 1
+    assert plat.maybe_corrupt("s", a) is a          # spent
+    assert plat.maybe_corrupt("other", a) is a      # other site: never
+
+
+def test_bitflip_clause_never_raises_in_inject_fault(monkeypatch):
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@s:1")
+    plat.maybe_inject_fault("s")    # must not raise
+
+
+def test_corrupt_hook_beats_env(monkeypatch):
+    calls = []
+
+    def hook(site, arr):
+        calls.append(site)
+        return plat.flip_bit(arr)
+
+    monkeypatch.setattr(plat, "corrupt_hook", hook)
+    a = np.zeros(8, np.int32)
+    b = plat.maybe_corrupt("x", a)
+    assert calls == ["x"] and (b != a).any()
+
+
+def test_flip_bit_changes_exactly_one_bit():
+    a = np.arange(32, dtype=np.int32)
+    b = plat.flip_bit(a)
+    diff = np.bitwise_xor(a.view(np.uint32), b.view(np.uint32))
+    assert (diff != 0).sum() == 1
+    assert bin(int(diff[diff != 0][0])).count("1") == 1
+
+
+def test_classifier_buckets_corrupt():
+    e = plat.CorruptDeviceResult("offline", "digest mismatch")
+    assert plat.classify_backend_error(e) == plat.FAULT_CORRUPT
+    assert plat.FAULT_CORRUPT in plat.FAULT_KINDS
+
+
+# -- digest parity (no false positives) -------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.float32])
+def test_host_device_digest_parity(dtype):
+    rng = np.random.default_rng(7)
+    a = rng.integers(-2 ** 31, 2 ** 31 - 1, (37, 11),
+                     dtype=np.int64).astype(np.int32).view(dtype)
+    import jax.numpy as jnp
+    dev = int(np.asarray(abft.digest_device(jnp.asarray(a))))
+    assert dev == abft.digest_host(a)
+
+
+def test_digest_detects_any_single_bitflip():
+    a = np.arange(64, dtype=np.int32)
+    d0 = abft.digest_host(a)
+    for bit in (0, 12, 31):
+        assert abft.digest_host(plat.flip_bit(a, bit)) != d0
+
+
+def test_attest_enabled_gate(monkeypatch):
+    assert plat.attest_enabled() is True            # default on
+    monkeypatch.setenv(plat.ATTEST_ENV, "0")
+    assert plat.attest_enabled() is False
+    assert plat.attest_enabled(True) is True        # override beats env
+
+
+# -- offline / batch / sharded: detection + identical verdicts --------------
+
+@pytest.fixture(scope="module")
+def offline_baseline():
+    return wgl.analysis_tpu(MODEL, _hist())
+
+
+@pytest.mark.parametrize("engine", ["dense", "sort"])
+def test_offline_bitflip_recovers_identically(engine, offline_baseline,
+                                              monkeypatch):
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@offline:1")
+    a = wgl.analysis_tpu(MODEL, _hist(), engine=engine)
+    assert a["valid?"] == offline_baseline["valid?"] is True
+    assert a["recovered"]["faults"] == ["corrupt"]
+    assert a["attested"]["steps"] == 1
+
+
+def test_offline_chunked_verifies_carry_digest():
+    a = wgl.analysis_tpu(MODEL, _hist(), chunk_entries=256)
+    assert a["valid?"] is True
+    assert a["attested"]["carry"] >= 1
+
+
+def test_offline_attest_off_documents_the_knob(monkeypatch):
+    # with attestation disabled the bitflip ships undetected: no
+    # 'corrupt' fault, no 'attested' stamp — the knob exists to
+    # measure the unguarded baseline, and this is its cost
+    monkeypatch.setenv(plat.ATTEST_ENV, "0")
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@offline:1")
+    a = wgl.analysis_tpu(MODEL, _hist())
+    assert "recovered" not in a
+    assert "attested" not in a
+
+
+BATCH_SEEDS = (10, 11, 12, 13)
+
+
+def _batch_hists():
+    return [_hist(seed=s, n=120, conc=3) for s in BATCH_SEEDS]
+
+
+@pytest.fixture(scope="module")
+def batch_baseline():
+    return [r["valid?"] for r in
+            wgl.analysis_tpu_batch(MODEL, _batch_hists())]
+
+
+def test_batch_bitflip_recovers_identically(batch_baseline,
+                                            monkeypatch):
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@batch:1")
+    rs = wgl.analysis_tpu_batch(MODEL, _batch_hists())
+    assert [r["valid?"] for r in rs] == batch_baseline
+    assert any(r.get("recovered", {}).get("faults") == ["corrupt"]
+               for r in rs)
+    assert all(r.get("attested") for r in rs)
+
+
+def test_sharded_bitflip_recovers_identically(monkeypatch):
+    ok0, pk0 = wgl.check_batch_sharded(MODEL, _batch_hists())
+    plat.reset_fault_injection()
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@sharded:1")
+    ok, pk, info = wgl.check_batch_sharded(MODEL, _batch_hists(),
+                                           return_info=True)
+    assert ok == ok0 and (pk == pk0).all()
+    assert info["recovered"]["faults"][0] == "corrupt"
+    assert info["attested"]["steps"] >= 1
+
+
+# -- stream-chunk: checkpointed resume with byte-identical stream -----------
+
+def _stream(hist, family, env=None, monkeypatch=None, **kw):
+    if env and monkeypatch is not None:
+        monkeypatch.setenv(plat.FAULT_INJECT_ENV, env)
+    plat.reset_fault_injection()
+    s = streaming.WglStream(
+        MODEL, chunk_entries=CHUNK, slots=SLOTS, checkpoint_every=2,
+        engine=family,
+        state_range=(-1, 4) if family == "dense" else None, **kw)
+    for op in hist.ops:
+        s.feed(op)
+    return s, s.finish()
+
+
+def _stream_bytes(s):
+    return (np.concatenate(s._steps_log) if s._steps_log
+            else np.zeros((0, 1), np.int32))
+
+
+@pytest.mark.parametrize("family", ["sort", "dense"])
+def test_stream_bitflip_resumes_identically(family, monkeypatch):
+    s0, r0 = _stream(_hist(), family)
+    assert r0["valid?"] is True and r0["attested"]["steps"] >= 1
+    s1, r1 = _stream(_hist(), family, env="bitflip@stream-chunk:3",
+                     monkeypatch=monkeypatch)
+    assert r1["valid?"] is True
+    rec = r1["recovered"]
+    assert rec["faults"] == ["corrupt"] and rec["retries"] == 1
+    assert rec["resumed-from-chunk"] == 2
+    b0, b1 = _stream_bytes(s0), _stream_bytes(s1)
+    assert b0.shape == b1.shape and (b0 == b1).all()
+
+
+def test_stream_bitflip_preserves_blame(monkeypatch):
+    bad = synth.corrupt(_hist(), seed=3)
+    s0, r0 = _stream(bad, "sort")
+    s1, r1 = _stream(bad, "sort", env="bitflip@stream-chunk:2",
+                     monkeypatch=monkeypatch)
+    assert r0["valid?"] is False and r1["valid?"] is False
+    assert r1["op-index"] == r0["op-index"]
+
+
+def test_stream_checkpoint_is_never_corrupt(monkeypatch):
+    # a flip in the chunk FEEDING a checkpoint must be detected at (or
+    # before) the checkpoint fetch, so the stored checkpoint is clean
+    # and recovery resumes from good state — checked implicitly by the
+    # identical-verdict assertions; here we pin that a corrupt fault
+    # detected at checkpoint time falls back to the previous one
+    s, r = _stream(_hist(n=1000), "sort", env="bitflip@stream-chunk:4",
+                   monkeypatch=monkeypatch)
+    assert r["valid?"] is True
+    assert r["recovered"]["faults"] == ["corrupt"]
+
+
+# -- elle: adjacency-stack digests + host-mirror final rung -----------------
+
+_CYCLE = {(0, 1): frozenset({"ww"}), (1, 2): frozenset({"wr"}),
+          (2, 0): frozenset({"rw"})}
+_FLAG_KEYS = ("G0", "G1c", "G-single", "G2-item")
+
+
+def test_elle_bitflip_detected_and_flags_identical(monkeypatch):
+    from jepsen_tpu.checker.elle import kernels
+    base = kernels.analyze_edges(3, dict(_CYCLE))
+    plat.reset_fault_injection()
+    monkeypatch.setenv(plat.FAULT_INJECT_ENV, "bitflip@elle:1")
+    hits = []
+    monkeypatch.setattr(plat, "corrupt_hook",
+                        lambda site, arr: hits.append(site) or None)
+    got = kernels.analyze_edges(3, dict(_CYCLE))
+    assert {k: got[k] for k in _FLAG_KEYS} \
+        == {k: base[k] for k in _FLAG_KEYS}
+    assert "elle" in hits                   # staging really happened
+
+
+def test_elle_persistent_corruption_takes_host_mirror(monkeypatch):
+    from jepsen_tpu.checker.elle import kernels
+    base = kernels.analyze_edges(3, dict(_CYCLE))
+    monkeypatch.setattr(
+        plat, "corrupt_hook",
+        lambda site, arr: plat.flip_bit(arr) if site == "elle"
+        else None)
+    got = kernels.analyze_edges(3, dict(_CYCLE))
+    assert {k: got[k] for k in _FLAG_KEYS} \
+        == {k: base[k] for k in _FLAG_KEYS}
+
+
+# -- carry digest host mirror ----------------------------------------------
+
+def test_verify_carry_catches_att_and_count(monkeypatch):
+    import jax.numpy as jnp
+    k = wgl._kernel("cas-register", 16, 8, 64, None)
+    carry = k.init_carry(jnp.int32(-1))
+    import jax
+    host = jax.device_get(carry)
+    dig = int(jax.device_get(k.digest(carry)))
+    abft.verify_carry("t", dig, host)       # clean carry passes
+    # corrupt att
+    bad = list(host)
+    bad[-3] = np.int32(1)
+    with pytest.raises(plat.CorruptDeviceResult):
+        abft.verify_carry("t", abft.carry_digest_host(tuple(bad)),
+                          tuple(bad))
+    # digest mismatch
+    with pytest.raises(plat.CorruptDeviceResult):
+        abft.verify_carry("t", dig ^ 1, host)

@@ -1,0 +1,359 @@
+"""Tier-1 verification: the O(n) invariant screen + tier plumbing.
+
+The contract under test (checker/screen.py, checker/linear.py,
+checker/elle/__init__.py): clean histories pass the screen with
+suspicion < 1; every history the full checker rejects in the labeled
+matrix escalates (no false negatives at the screen boundary);
+escalation is deterministic, priced through wgl.select_engine, and
+surfaced through Compose / core.log_results / report / web alongside
+the recovered/degraded trails without breaking older stored results.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import models
+from jepsen_tpu.checker import Compose, linear, screen, synth
+from jepsen_tpu.checker.elle import RWRegisterChecker
+
+MODEL = models.cas_register()
+
+
+def _hist(seed=13, n=400, conc=4, **kw):
+    return synth.register_history(n, concurrency=conc, values=5,
+                                  seed=seed, **kw)
+
+
+# -- the register screen ----------------------------------------------------
+
+def test_clean_register_histories_pass():
+    for seed in (13, 21, 7, 45100):
+        sc = screen.screen_history(MODEL, _hist(seed=seed))
+        assert sc["valid?"] is True and sc["screened"]
+        assert sc["suspicion"] < screen.ESCALATE_THRESHOLD, \
+            (seed, sc["violations"][:2])
+
+
+def test_corrupt_register_flags_phantom_read():
+    sc = screen.screen_history(MODEL, synth.corrupt(_hist(), seed=3))
+    assert sc["valid?"] is False
+    assert sc["violations"][0]["check"] == "phantom-read"
+    assert sc["suspicion"] >= screen.ESCALATE_THRESHOLD
+
+
+def test_stale_read_detected_and_full_checker_agrees():
+    ops = [
+        {"type": "invoke", "f": "write", "value": 1, "process": 0},
+        {"type": "ok", "f": "write", "value": 1, "process": 0},
+        {"type": "invoke", "f": "write", "value": 2, "process": 1},
+        {"type": "ok", "f": "write", "value": 2, "process": 1},
+        {"type": "invoke", "f": "read", "value": None, "process": 2},
+        {"type": "ok", "f": "read", "value": 1, "process": 2},
+    ]
+    sc = screen.screen_history(models.register(), ops)
+    assert [v["check"] for v in sc["violations"]] == ["stale-read"]
+    from jepsen_tpu.checker import wgl
+    assert wgl.analysis_tpu(models.register(), ops)["valid?"] is False
+
+
+def test_concurrent_write_is_not_stale():
+    # the overwriting 'write 2' is still in flight when the read
+    # completes: observing 1 is legal, the screen must stay quiet
+    ops = [
+        {"type": "invoke", "f": "write", "value": 1, "process": 0},
+        {"type": "ok", "f": "write", "value": 1, "process": 0},
+        {"type": "invoke", "f": "write", "value": 2, "process": 1},
+        {"type": "invoke", "f": "read", "value": None, "process": 2},
+        {"type": "ok", "f": "read", "value": 1, "process": 2},
+        {"type": "ok", "f": "write", "value": 2, "process": 1},
+    ]
+    sc = screen.screen_history(models.register(), ops)
+    assert sc["valid?"] is True
+
+
+def test_crashed_write_softens_but_never_escalates_alone():
+    h = _hist(seed=13, crash_rate=0.1)
+    sc = screen.screen_history(MODEL, h)
+    assert sc["valid?"] is True
+    assert 0 < sc["suspicion"] < screen.ESCALATE_THRESHOLD
+    assert sc["signals"]["crashed-mutators"] > 0
+
+
+# -- counter / g-set screens ------------------------------------------------
+
+def test_counter_clean_and_bounds_violation():
+    hc = synth.counter_history(400, concurrency=4, seed=11)
+    assert screen.screen_history(models.counter(), hc)["valid?"] \
+        is True
+    ops = [
+        {"type": "invoke", "f": "add", "value": 5, "process": 0},
+        {"type": "ok", "f": "add", "value": 5, "process": 0},
+        {"type": "invoke", "f": "read", "value": None, "process": 1},
+        {"type": "ok", "f": "read", "value": 100, "process": 1},
+    ]
+    sc = screen.screen_history(models.counter(), ops)
+    assert sc["violations"][0]["check"] == "counter-bounds"
+    assert sc["violations"][0]["hi"] == 5
+
+
+def test_gset_lost_and_phantom_elements():
+    hg = synth.gset_history(300, concurrency=4, seed=9)
+    assert screen.screen_history(models.gset(), hg)["valid?"] is True
+    ops = [
+        {"type": "invoke", "f": "add", "value": 3, "process": 0},
+        {"type": "ok", "f": "add", "value": 3, "process": 0},
+        {"type": "invoke", "f": "read", "value": None, "process": 1},
+        {"type": "ok", "f": "read", "value": [9], "process": 1},
+    ]
+    sc = screen.screen_history(models.gset(), ops)
+    checks = sorted(v["check"] for v in sc["violations"])
+    assert checks == ["set-lost", "set-phantom"]
+
+
+# -- the wr screen ----------------------------------------------------------
+
+def test_wr_clean_passes():
+    sc = screen.screen_wr(synth.wr_history(300, concurrency=6, seed=5))
+    assert sc["valid?"] is True and sc["signals"]["cyclic-sccs"] == 0
+
+
+def test_wr_duplicate_write_flagged():
+    txn = [["w", 0, 1]]
+    ops = []
+    for p in (0, 1):
+        ops.append({"type": "invoke", "f": "txn", "value": txn,
+                    "process": p})
+        ops.append({"type": "ok", "f": "txn", "value": txn,
+                    "process": p})
+    sc = screen.screen_wr(ops)
+    assert any(v["check"] == "duplicate-writes"
+               for v in sc["violations"])
+
+
+def test_wr_cycle_existence_is_exact():
+    # a ww cycle with no single-pass anomaly: t0 writes x=1,y=2 after
+    # reading the other's values — build edges via intra-txn order
+    ops = [
+        {"type": "invoke", "f": "txn", "value": None, "process": 0},
+        {"type": "ok", "f": "txn",
+         "value": [["r", 0, 2], ["w", 0, 1]], "process": 0},
+        {"type": "invoke", "f": "txn", "value": None, "process": 1},
+        {"type": "ok", "f": "txn",
+         "value": [["r", 0, 1], ["w", 0, 2]], "process": 1},
+    ]
+    sc = screen.screen_wr(ops)
+    assert sc["valid?"] is False
+    assert any(v["check"] == "dependency-cycle"
+               for v in sc["violations"])
+    # the full checker classifies the same cycle
+    from jepsen_tpu.checker.elle import wr
+    full = wr.check(ops)
+    assert full["valid?"] is False
+
+
+# -- escalation decision ----------------------------------------------------
+
+def test_sample_decision_is_deterministic():
+    assert screen.sample_decision(123, 1.0) is True
+    assert screen.sample_decision(123, 0.0) is False
+    a = [screen.sample_decision(k, 0.3) for k in range(200)]
+    assert a == [screen.sample_decision(k, 0.3) for k in range(200)]
+    assert 20 < sum(a) < 120      # roughly the asked fraction
+
+
+def test_should_escalate_scales_sampling_by_cost():
+    sc = {"suspicion": 0.0, "op-count": 777}
+    # find a key that samples at full strength
+    esc_full, why = screen.should_escalate(sc, sample=1.0)
+    assert esc_full and why == "sampled"
+    # an astronomically expensive history suppresses sampling
+    esc_costly, _ = screen.should_escalate(
+        sc, sample=0.5, cost=screen.COST_REF * 1e9)
+    assert esc_costly is False
+
+
+def test_price_escalation_reports_engine_and_cost():
+    p = screen.price_escalation(MODEL, _hist(n=100))
+    assert p is not None
+    assert p["family"] in ("dense", "sort") and p["cost"] > 0
+
+
+# -- Linearizable tier plumbing --------------------------------------------
+
+def test_tier_screen_pass_returns_screened_verdict():
+    c = linear.Linearizable(MODEL, tier="screen", screen_sample=0.0)
+    r = c.check({}, _hist(), {})
+    assert r["screened"] and r["valid?"] is True and r["tier"] == 1
+    assert "escalated" not in r and r["analyzer"] == "tier1-screen"
+
+
+def test_tier_screen_suspicion_escalates_with_blame():
+    c = linear.Linearizable(MODEL, tier="screen", screen_sample=0.0)
+    r = c.check({}, synth.corrupt(_hist(), seed=3), {})
+    assert r["valid?"] is False and "op-index" in r
+    assert r["escalated"]["why"] == "suspicion"
+    assert r["escalated"]["engine"]["family"] in ("dense", "sort")
+
+
+def test_tier_screen_sampled_escalation():
+    c = linear.Linearizable(MODEL, tier="screen", screen_sample=1.0)
+    r = c.check({}, _hist(), {})
+    assert r["valid?"] is True and r["escalated"]["why"] == "sampled"
+
+
+def test_unscreenable_model_always_escalates():
+    # a model family the screen has no invariants for must NEVER pass
+    # on the sampled-audit path — a no-op screen escalates every time
+    h = synth.mutex_history(60, concurrency=3, seed=5)
+    sc = screen.screen_history(models.mutex(), h)
+    assert sc["screenable"] is False
+    esc, why = screen.should_escalate(sc, sample=0.0)
+    assert esc and why == "unscreened-model"
+    c = linear.Linearizable(models.mutex(), tier="screen",
+                            screen_sample=0.0)
+    r = c.check({}, h, {})
+    assert "screened" not in r            # the full checker answered
+    assert r["escalated"]["why"] == "unscreened-model"
+
+
+def test_tier_from_test_map_and_default_full():
+    r = linear.Linearizable(MODEL).check(
+        {"tier": "screen", "screen-sample": 0.0}, _hist(), {})
+    assert r.get("screened")
+    r2 = linear.Linearizable(MODEL).check({}, _hist(n=100), {})
+    assert "screened" not in r2 and "tier" not in r2
+
+
+def test_screen_boundary_no_false_negatives():
+    """The acceptance matrix: over labeled clean/anomalous histories,
+    the screen never passes (without escalation) a history the full
+    checker rejects."""
+    from jepsen_tpu.checker import wgl
+    matrix = [_hist(seed=s, n=200) for s in (13, 21, 7)]
+    matrix += [synth.corrupt(h, seed=i + 3)
+               for i, h in enumerate(matrix[:3])]
+    for h in matrix:
+        sc = screen.screen_history(MODEL, h)
+        esc, _ = screen.should_escalate(sc, sample=0.0)
+        full = wgl.analysis_tpu(MODEL, h, budget_s=60, explain=False)
+        if full["valid?"] is False:
+            assert esc, "screen passed a history the full checker " \
+                        "rejects"
+
+
+def test_rw_register_checker_tier():
+    hw = synth.wr_history(200, concurrency=6, seed=5)
+    rc = RWRegisterChecker()
+    r = rc.check({"tier": "screen", "screen-sample": 0.0}, hw, {})
+    assert r["screened"] and r["valid?"] is True
+    r2 = rc.check({"tier": "screen", "screen-sample": 1.0}, hw, {})
+    assert r2["escalated"]["why"] == "sampled"
+    assert "anomalies" in r2        # the full result shape
+
+
+# -- online integration -----------------------------------------------------
+
+def test_maybe_online_adds_screen_targets():
+    from jepsen_tpu.checker import streaming
+    test = {"online": True, "tier": "screen",
+            "checker": Compose({"lin": linear.Linearizable(MODEL),
+                                "wr": RWRegisterChecker()})}
+    oc = streaming.maybe_online(test)
+    try:
+        assert "screen-linear" in oc.targets
+        assert "screen-wr" in oc.targets
+    finally:
+        oc.close()
+
+
+def test_streamed_screen_result_is_reused():
+    h = _hist(n=100)
+    sc = screen.screen_history(MODEL, h)
+    sc["marker"] = "from-stream"
+    test = {"tier": "screen", "screen-sample": 0.0,
+            "streamed-results": {"screen-linear": sc}}
+    r = linear.Linearizable(MODEL).check(test, h, {})
+    assert r.get("marker") == "from-stream"
+    # a screen covering a different history is NOT reused
+    test2 = {"tier": "screen", "screen-sample": 0.0,
+             "streamed-results": {"screen-linear": dict(
+                 sc, **{"history-len": 1})}}
+    r2 = linear.Linearizable(MODEL).check(test2, h, {})
+    assert "marker" not in r2
+
+
+def test_screen_stream_violation_flag_for_abort():
+    s = screen.ScreenStream(MODEL)
+    for op in synth.corrupt(_hist(), seed=3).ops:
+        s.feed(op)
+        if s.violation:
+            break
+    assert s.violation
+
+
+# -- surfacing --------------------------------------------------------------
+
+def test_compose_surfaces_tier_outcomes():
+    class _Returns:
+        def __init__(self, result):
+            self.result = result
+
+        def __call__(self, test, hist, opts):
+            return dict(self.result)
+
+    r = Compose({
+        "passed": _Returns({"valid?": True, "screened": True}),
+        "bumped": _Returns({"valid?": True,
+                            "escalated": {"why": "sampled"}}),
+        "guarded": _Returns({"valid?": True,
+                             "attested": {"steps": 1, "carry": 0}}),
+        "legacy": _Returns({"valid?": True}),
+    }).check({}, [], {})
+    assert r["screened-checkers"] == ["passed"]
+    assert r["escalated-checkers"] == ["bumped"]
+    assert r["attested-checkers"] == ["guarded"]
+
+
+def test_report_tier_line_and_legacy_results():
+    from jepsen_tpu import report
+    assert report.tier_line({}) == ""
+    assert report.tier_line({"valid?": True}) == ""      # old results
+    line = report.tier_line({"screened": True, "suspicion": 0.04})
+    assert "screen passed" in line
+    line = report.tier_line(
+        {"escalated": {"why": "suspicion", "suspicion": 2.0,
+                       "engine": {"family": "dense", "cost": 1e6}}})
+    assert "escalated" in line and "dense" in line
+
+
+def test_web_note_tier_suffixes_and_precedence():
+    from jepsen_tpu import web
+    assert web.recovery_note({"lin": {"valid?": True}}) == ""
+    assert web.recovery_note(
+        {"lin": {"valid?": True, "screened": True}}) == " (screened)"
+    assert web.recovery_note(
+        {"lin": {"escalated": {"why": "sampled"}}}) == " (escalated)"
+    # fault outcomes outrank tier notes
+    assert web.recovery_note(
+        {"lin": {"screened": True},
+         "o": {"recovered": {"faults": ["oom"]}}}) == " (recovered)"
+
+
+def test_log_results_tier_summary(caplog):
+    import logging
+
+    from jepsen_tpu import core
+    with caplog.at_level(logging.INFO, logger="jepsen_tpu.core"):
+        core.log_results({"results": {
+            "valid?": True,
+            "screened-checkers": ["lin"],
+            "attested-checkers": ["lin"],
+            "lin": {"valid?": True, "screened": True,
+                    "suspicion": 0.0}}})
+    assert any("tier-1 verification" in m for m in caplog.messages)
+    assert any("ABFT attestation" in m for m in caplog.messages)
+
+
+def test_cli_exposes_tier_knobs():
+    from jepsen_tpu import cli
+    longs = {s["long"] for s in cli.test_opt_spec()}
+    assert "--tier" in longs and "--screen-sample" in longs

@@ -59,7 +59,7 @@ def test_kernel_dedup_first_seen_order_and_new_flags():
     rng = np.random.default_rng(3)
     keys = rng.integers(0, 12, N).astype(np.int32)
     keys[rng.random(N) < 0.25] = wgl_dedup.EMPTY
-    out, new, cnt = map(np.asarray, fn(keys))
+    out, new, cnt, dig = map(np.asarray, fn(keys))
     # reference: first-seen order over valid keys
     seen: dict = {}
     for i, k in enumerate(keys.tolist()):
@@ -71,13 +71,23 @@ def test_kernel_dedup_first_seen_order_and_new_flags():
     assert int(cnt) == len(want)
     assert (out[len(want):] == wgl_dedup.EMPTY).all()
     assert not new[len(want):].any()
+    # the table-occupancy XOR digest matches a host recompute over the
+    # distinct keys — the cross-check wgl.dedup_hash folds into att
+    exp = 0
+    for k, _ in want:
+        exp ^= k
+    exp ^= (len(want) * wgl_dedup.DIGEST_COUNT_MIX) & 0xFFFFFFFF
+    exp &= 0xFFFFFFFF
+    if exp >= 1 << 31:
+        exp -= 1 << 32
+    assert int(dig) == exp
 
 
 def test_kernel_dedup_overflow_counts_all_distinct():
     N, F = 64, 8
     fn = wgl_dedup.dedup_fn(N, F, interpret=True)
     keys = np.arange(N, dtype=np.int32)          # all distinct
-    out, new, cnt = map(np.asarray, fn(keys))
+    out, new, cnt, _dig = map(np.asarray, fn(keys))
     assert int(cnt) == N                         # > F: overflow signal
     assert out.tolist() == list(range(F))        # first F kept
     assert (~new[:F]).sum() == F                 # all old-segment rows
@@ -85,8 +95,9 @@ def test_kernel_dedup_overflow_counts_all_distinct():
 
 def test_kernel_dedup_all_empty():
     fn = wgl_dedup.dedup_fn(32, 8, interpret=True)
-    out, new, cnt = map(np.asarray, fn(np.full(32, -1, np.int32)))
+    out, new, cnt, dig = map(np.asarray, fn(np.full(32, -1, np.int32)))
     assert int(cnt) == 0 and (out == wgl_dedup.EMPTY).all()
+    assert int(dig) == 0
 
 
 def test_eligibility_bounds():
