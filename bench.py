@@ -759,6 +759,148 @@ def section_config5bad():
     return {"seconds": round(dt, 2)}
 
 
+def section_service():
+    """The persistent verification service (jepsen_tpu/service.py):
+    aggregate checking throughput vs concurrent stream count, the
+    isolation overhead of serving a stream next to siblings vs a solo
+    OnlineChecker-style stream, and drain-and-resume latency vs an
+    uninterrupted run.
+
+    Device-light by design: the per-stream kernels are the streaming
+    section's; what this section measures is the SERVING layer —
+    queueing, the cost-model budget, checkpoint/manifest round-trips."""
+    import json as _json
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from jepsen_tpu import service as _service, store as _store
+    from jepsen_tpu.checker import streaming as _streaming, synth
+
+    model = _model()
+    n = max(N_OPS // 20, 400)
+    chunk = 64
+    slots = 8
+    frontier = 128
+
+    def jops(h):
+        return [_json.loads(_json.dumps(op,
+                                        default=_store._json_default))
+                for op in h.ops]
+
+    def spec():
+        return {"linear": {
+            "kind": "wgl", "model": _service.model_spec(model),
+            "chunk-entries": chunk, "slots": slots, "engine": "sort",
+            "frontier": frontier, "checkpoint-every": 2}}
+
+    def solo(ops):
+        s = _streaming.WglStream(model, chunk_entries=chunk,
+                                 slots=slots, frontier=frontier,
+                                 checkpoint_every=2)
+        t0 = time.monotonic()
+        for op in ops:
+            s.feed(op)
+        r = s.finish()
+        assert r["valid?"] is True, r
+        return time.monotonic() - t0
+
+    smoke = N_OPS < DEFAULT_N_OPS // 4
+    counts = (1, 2, 4) if smoke else (1, 2, 4, 8)
+    hists = {i: jops(synth.register_history(
+        n, concurrency=3, values=5, seed=300 + i))
+        for i in range(max(counts))}
+    solo(hists[0])                   # warm every kernel shape
+    solo_s = solo(hists[0])
+
+    # -- aggregate throughput vs stream count -------------------------
+    scaling = {}
+    iso_overhead = None
+    for m in counts:
+        svc = _service.VerificationService()
+        for i in range(m):
+            svc.admit(f"s{i}", spec())
+
+        per_stream: dict = {}
+
+        def feed(i):
+            t0 = time.monotonic()
+            for op in hists[i]:
+                svc.offer(f"s{i}", op)
+            svc.seal(f"s{i}")
+            r = svc.result(f"s{i}", timeout_s=600)
+            # a shed/quarantined stream returns fast with no verdict
+            # and would fake great throughput numbers
+            assert r.get("linear", {}).get("valid?") is True, \
+                f"stream s{i} lost its verdict: {r}"
+            per_stream[i] = time.monotonic() - t0
+
+        t0 = time.monotonic()
+        ths = [_threading.Thread(target=feed, args=(i,))
+               for i in range(m)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        wall = time.monotonic() - t0
+        total_ops = sum(len(hists[i]) for i in range(m))
+        scaling[m] = {"wall_s": round(wall, 3),
+                      "agg_ops_per_s": round(total_ops / wall, 1)}
+        if m == max(counts):
+            # isolation overhead: one stream's latency served among
+            # (m-1) siblings vs the solo OnlineChecker-style stream
+            iso_overhead = round(per_stream[0] / max(solo_s, 1e-4), 2)
+
+    # -- drain-and-resume latency -------------------------------------
+    tmp = _tempfile.mkdtemp(prefix="bench-service-")
+    try:
+        run_dir = os.path.join(tmp, "bench", "t0")
+        os.makedirs(run_dir)
+        with open(os.path.join(run_dir, "journal.jsonl"), "w") as fh:
+            for op in hists[0]:
+                fh.write(_json.dumps(
+                    op, default=_store._json_default) + "\n")
+        import gzip as _gzip
+        with _gzip.open(os.path.join(run_dir, "history.jsonl.gz"),
+                        "wt") as fh:
+            for op in hists[0]:
+                fh.write(_json.dumps(
+                    op, default=_store._json_default) + "\n")
+        svc = _service.VerificationService()
+        svc.admit("t0", spec(), store_dir=run_dir)
+        for op in hists[0][:len(hists[0]) // 2]:
+            svc.offer("t0", op)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            w = svc.workers["t0"]
+            if w.targets["linear"]._ckpt is not None and w.q.empty():
+                break
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        svc.drain()
+        drain_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        svc2 = _service.VerificationService()
+        name = svc2.resume(run_dir)
+        r = svc2.result(name, timeout_s=600)
+        resume_s = time.monotonic() - t0
+        assert r["linear"]["valid?"] is True, r
+        svc2.stop()
+    finally:
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+    return {"service": {
+        "shape": f"{n}-op register streams (conc 3, chunk {chunk}, "
+                 f"F {frontier})",
+        "solo_stream_s": round(solo_s, 3),
+        "scaling": scaling,
+        "isolation_overhead_x": iso_overhead,
+        "drain_s": round(drain_s, 3),
+        "resume_to_verdict_s": round(resume_s, 3),
+        "uninterrupted_s": round(solo_s, 3),
+    }}
+
+
 def section_generator():
     """Generator throughput, host-only (reference: >20k ops/s
     single-thread, generator.clj:66-70)."""
@@ -793,6 +935,7 @@ SECTIONS = [
     ("addgraphs", section_addgraphs, 600, True),
     ("config4", section_config4, 900, True),
     ("config5", section_config5, 1200, True),
+    ("service", section_service, 600, True),
     ("generator", section_generator, 180, False),
 ]
 

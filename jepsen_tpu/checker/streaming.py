@@ -307,13 +307,22 @@ class WglStream:
                  concurrency_hint: int | None = None,
                  pallas=None,
                  checkpoint_every: int = DEFAULT_CHECKPOINT_EVERY,
-                 max_recovery_retries: int | None = None):
+                 max_recovery_retries: int | None = None,
+                 auto_pump: bool = True,
+                 fault_site: str = "stream-chunk"):
         name = model.device_model
         if name is None or name not in _wgl.DEVICE_MODELS:
             raise ValueError(f"model {model!r} has no device form")
         self.model = model
         self.name = name
         self.dm = _wgl.DEVICE_MODELS[name]
+        # service scheduling: auto_pump=False turns feed() into
+        # encode-only — a scheduler calls pump() to dispatch chunks
+        # under its own budget. fault_site names this stream's fault-
+        # injection/attestation site so a multi-stream service can
+        # target (and account) faults per stream.
+        self.auto_pump = bool(auto_pump)
+        self.fault_site = fault_site
         self.chunk = _wgl._bucket(max(int(chunk_entries), 1), lo=64)
         self.frontier = frontier
         self.max_frontier = max_frontier
@@ -365,6 +374,9 @@ class WglStream:
         self._trail = _wgl._RecoveryTrail(max_recovery_retries)
         # (rows consumed, chunks dispatched, host-resident carry)
         self._ckpt: tuple[int, int, tuple] | None = None
+        # an imported (cross-process) checkpoint waiting to seed the
+        # carry at the next kernel build — see import_checkpoint()
+        self._restore_ckpt_pending = False
         self._rows_fed = 0        # step rows appended to the log
         self._rows_done = 0       # step rows the device has consumed
         self._resumed_from_chunk: int | None = None
@@ -378,6 +390,10 @@ class WglStream:
         self._att_pending: list[tuple] = []   # (device digest, expected)
         self._att_steps = 0
         self._att_carry = 0
+        # attestation tallies as of the last checkpoint — exported
+        # with it so a cross-process resume reports the same totals
+        # as an uninterrupted run
+        self._ckpt_att = (0, 0)
 
     @property
     def faults(self) -> list:
@@ -433,6 +449,12 @@ class WglStream:
         # compile warm-up: consumes nothing, leaves the carry untouched
         self._carry = self._k.check_stream_chunk(
             self._bufs[0], jnp.int32(0), self._carry)
+        if self._restore_ckpt_pending and self._ckpt is not None:
+            # a checkpoint imported from a drained service: seed the
+            # carry from it so the refed prefix (skipped row-for-row by
+            # _dispatch_once) resumes instead of recomputing
+            self._carry = tuple(jnp.asarray(a) for a in self._ckpt[2])
+            self._restore_ckpt_pending = False
 
     # -- fault tolerance ---------------------------------------------------
 
@@ -511,14 +533,14 @@ class WglStream:
                 else np.zeros((0, self.encoder.w + 4), np.int32))
         for e in range(0, len(tail), self.chunk):
             sl = tail[e:e + self.chunk]
-            maybe_inject_fault("stream-chunk")
+            maybe_inject_fault(self.fault_site)
             # fresh staging per slice: unlike the live path, this loop
             # enqueues without a per-chunk liveness sync, so reusing
             # the double buffers could rewrite one still feeding an
             # in-flight async chunk
             buf = np.repeat(self._pad_row[None], self.chunk, axis=0)
             buf[:len(sl)] = sl
-            xj = jnp.asarray(maybe_corrupt("stream-chunk", buf))
+            xj = jnp.asarray(maybe_corrupt(self.fault_site, buf))
             if self._attest:
                 from . import abft
                 self._att_pending.append(
@@ -544,6 +566,13 @@ class WglStream:
         if not self.checkpoint_every \
                 or self._chunks % self.checkpoint_every:
             return
+        self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        """Fetch the carry to host memory NOW and store it as the
+        recovery target (the cadence-independent body of
+        _maybe_checkpoint — also the drain path of a verification
+        service, which checkpoints every stream before exiting)."""
         if self._attest:
             # a checkpoint must be KNOWN GOOD before it becomes the
             # recovery target: verify every staged chunk that fed it,
@@ -555,12 +584,13 @@ class WglStream:
             host, hd = guarded_device_get(
                 (self._carry, self._k.digest(self._carry)),
                 site="stream checkpoint")
-            abft.verify_carry("stream-chunk", hd, host)
+            abft.verify_carry(self.fault_site, hd, host)
             self._att_carry += 1
         else:
             host = guarded_device_get(self._carry,
                                       site="stream checkpoint")
         self._ckpt = (self._rows_done, self._chunks, host)
+        self._ckpt_att = (self._att_steps, self._att_carry)
 
     def _recovering(self, fn: Callable[[], Any], site: str,
                     restore: bool = True):
@@ -612,7 +642,8 @@ class WglStream:
             log.warning("online WGL stream disabled (%s); the offline "
                         "checker will run instead", e)
             return
-        self._pump()
+        if self.auto_pump:
+            self._pump()
 
     def _rebuild(self, p: int) -> None:
         """Re-encode the full feed with new parameters and replay the
@@ -655,6 +686,7 @@ class WglStream:
         # a rebuild replaces the kernel family/shape: the old carry
         # checkpoint no longer matches and the steps log restarts
         self._ckpt = None
+        self._restore_ckpt_pending = False
         self._rows_fed = self._rows_done = 0
         self._dead = self._dead_overflow = False
         self.violation = False
@@ -663,17 +695,22 @@ class WglStream:
         for op in ops:
             self.feed(op)
 
-    def _pump(self, partial: bool = False) -> None:
-        """Dispatch full chunks (and, when partial=True, the tail)."""
-        while True:
+    def _pump(self, partial: bool = False,
+              limit: int | None = None) -> int:
+        """Dispatch full chunks (and, when partial=True, the tail).
+        limit caps the number of chunks dispatched this call — the
+        service scheduler's unit of budget. Returns chunks
+        dispatched."""
+        done = 0
+        while limit is None or done < limit:
             if self._failed is not None:
                 # the recovery budget died mid-drain: every further
                 # chunk would re-attempt a kernel build + dispatch on
                 # the broken backend (each up to a watchdog deadline)
-                return
+                return done
             avail = self.encoder.available()
             if avail == 0 or (avail < self.chunk and not partial):
-                return
+                return done
             rows = self.encoder.take(self.chunk)
             arr = np.asarray(rows, np.int32)
             if (self.engine == "dense" or self._pack is not None) \
@@ -689,14 +726,106 @@ class WglStream:
                 self.engine = "sort"
                 self._pack = None
                 self._rebuild(p=self.p)
-                return
+                return done
             self._dispatch(arr)
+            done += 1
+        return done
 
     def _range_escape(self, arr: np.ndarray) -> bool:
         w = self.encoder.w
         lo, hi = self.state_range
         vals = arr[:, w + 2:]
         return bool(((vals != NIL) & ((vals < lo) | (vals > hi))).any())
+
+    # -- service scheduling (externally pumped chunks) ---------------------
+
+    def pending_chunks(self) -> int:
+        """Full chunks encoded and waiting for dispatch — what a
+        service scheduler weighs against its budget."""
+        if self._failed is not None:
+            return 0
+        return self.encoder.available() // self.chunk
+
+    def pump(self, max_chunks: int | None = None) -> int:
+        """Dispatch up to max_chunks full chunks (None = all). The
+        external-pump entry for a verification service; with
+        auto_pump=True, feed() already pumps and this is a no-op
+        unless chunks piled up."""
+        return self._pump(limit=max_chunks)
+
+    def checkpoint_now(self) -> bool:
+        """Force a carry checkpoint regardless of cadence — the drain
+        path. True when a checkpoint was stored (False when nothing
+        was ever dispatched, the stream already failed, or the
+        recovery budget died trying)."""
+        if self._failed is not None or self._k is None:
+            return False
+        ok = self._recovering(
+            lambda: self._checkpoint() or True, "checkpoint") is not None
+        if ok and self._attest:
+            # the forced checkpoint's own carry verification is drain
+            # overhead, not part of the stream's verdict: exclude it
+            # from the exported tallies so a resumed stream reports
+            # totals identical to an uninterrupted run's (cadence
+            # checkpoints always fired inside dispatch already)
+            self._ckpt_att = (self._ckpt_att[0], self._ckpt_att[1] - 1)
+        return ok
+
+    def export_checkpoint(self) -> dict | None:
+        """The last carry checkpoint plus the kernel-shape parameters
+        needed to rebuild an equivalent stream in another process —
+        what a draining service persists. None when no checkpoint
+        exists (resume then re-feeds from scratch: cold, correct)."""
+        if self._ckpt is None:
+            return None
+        rows, chunks, host = self._ckpt
+        return {
+            "rows": int(rows),
+            "chunks": int(chunks),
+            "carry": [np.asarray(a) for a in host],
+            "engine": self.engine,
+            "p": int(self.p),
+            "chunk": int(self.chunk),
+            "frontier": int(self.frontier),
+            "pallas": self.pallas,
+            "packed": self._pack is not None,
+            "att-steps": int(self._ckpt_att[0]),
+            "att-carry": int(self._ckpt_att[1]),
+            "state-range": (list(self.state_range)
+                            if self.state_range is not None else None),
+        }
+
+    def import_checkpoint(self, ck: dict) -> bool:
+        """Seed a FRESH stream from an exported checkpoint: the caller
+        re-feeds the journal from the beginning, the encoder re-emits
+        the byte-identical step stream, and dispatch skips row-for-row
+        up to the checkpoint (restoring its carry at the first kernel
+        build) — so the resumed verdict is identical to an
+        uninterrupted run's. Returns False (stream stays cold) when
+        the checkpoint's kernel shape doesn't match this stream's."""
+        if self.encoder.n_client_ops or self._chunks or self._steps_log:
+            raise ValueError("import_checkpoint on a stream that "
+                             "already consumed ops")
+        if (ck.get("engine") != self.engine or int(ck["p"]) != self.p
+                or int(ck["chunk"]) != self.chunk
+                or int(ck["frontier"]) != self.frontier
+                or bool(ck.get("packed")) != (self._pack is not None)):
+            log.warning("stream checkpoint shape mismatch (%s/%s/%s/%s "
+                        "vs %s/%s/%s/%s); resuming cold",
+                        ck.get("engine"), ck.get("p"), ck.get("chunk"),
+                        ck.get("frontier"), self.engine, self.p,
+                        self.chunk, self.frontier)
+            return False
+        carry = tuple(np.asarray(a) for a in ck["carry"])
+        self._ckpt = (int(ck["rows"]), int(ck["chunks"]), carry)
+        self._rows_done = int(ck["rows"])
+        self._chunks = int(ck["chunks"])
+        self._resumed_from_chunk = int(ck["chunks"])
+        self._att_steps = int(ck.get("att-steps", 0))
+        self._att_carry = int(ck.get("att-carry", 0))
+        self._ckpt_att = (self._att_steps, self._att_carry)
+        self._restore_ckpt_pending = True
+        return True
 
     def _dispatch(self, arr: np.ndarray) -> None:
         self._steps_log.append(arr)
@@ -720,14 +849,14 @@ class WglStream:
             return   # a recovery replay already consumed this slice
         if self._k is None:
             self._setup()
-        maybe_inject_fault("stream-chunk")
+        maybe_inject_fault(self.fault_site)
         buf = self._bufs[self._chunks % 2]
         n = len(arr)
         buf[:n] = arr
         if n < self.chunk:
             buf[n:] = self._pad_row
         prev = self._carry
-        xj = jnp.asarray(maybe_corrupt("stream-chunk", buf))
+        xj = jnp.asarray(maybe_corrupt(self.fault_site, buf))
         if self._attest:
             # enqueue the shipped buffer's device digest; the host
             # digest comes from the canonical staging buffer BEFORE it
@@ -757,7 +886,7 @@ class WglStream:
             d, exp = self._att_pending[0]
             from . import abft
             abft.verify_steps(
-                "stream-chunk",
+                self.fault_site,
                 guarded_device_get(d, site="stream attest"), exp)
             self._att_pending.pop(0)
             self._att_steps += 1
@@ -774,9 +903,9 @@ class WglStream:
             site="stream liveness")
         ok, _death, overflow, _maxc, att = summary
         for dv, (_, exp) in zip(digs, pend):
-            abft.verify_steps("stream-chunk", dv, exp)
+            abft.verify_steps(self.fault_site, dv, exp)
             self._att_steps += 1
-        _wgl._check_att(att, "stream-chunk")
+        _wgl._check_att(att, self.fault_site)
         self._chunk_syncs += 1
         if not bool(ok):
             self._dead = True
@@ -850,7 +979,7 @@ class WglStream:
                 self._drain_attest()
             out = guarded_device_get(
                 self._k.summarize(self._carry), site="stream summarize")
-            _wgl._check_att(out[-1], "stream-chunk")
+            _wgl._check_att(out[-1], self.fault_site)
             return out
 
         settled = self._recovering(_settle, "summarize")
@@ -877,7 +1006,7 @@ class WglStream:
                     k2.summarize(carry), site="stream escalate")
                 # inside the closure so a corrupt att re-runs under
                 # the same recovery ladder as any other fault here
-                _wgl._check_att(out[-1], "stream-chunk")
+                _wgl._check_att(out[-1], self.fault_site)
                 return k2, out
 
             esc = self._recovering(_escalate, "escalate",

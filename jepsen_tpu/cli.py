@@ -106,6 +106,14 @@ def test_opt_spec() -> list[dict]:
                  "tails the run's journal and advances the device "
                  "search while the run executes, so analysis latency "
                  "collapses to the unchecked tail."),
+        opt("--service", metavar="ADDR", default=None,
+            help="Attach this run's journal stream to a persistent "
+                 "verification service (see the `service` command) "
+                 "at ADDR (host:port, or a unix socket path) instead "
+                 "of spawning an in-process online checker. A "
+                 "refused or unreachable service falls back to local "
+                 "checking; a shed (overloaded) stream is verified "
+                 "offline from its journal."),
         opt("--abort-on-violation", action="store_true",
             help="With --online: abort the run as soon as the "
                  "streaming checker confirms a nonlinearizable "
@@ -481,9 +489,58 @@ def serve_cmd() -> dict:
     }}
 
 
+def service_cmd() -> dict:
+    """The persistent-verification-service command: a daemon that
+    accepts live journal streams from many concurrent runs over a
+    local socket (`run --service ADDR`) and/or by tail-following a
+    store directory, multiplexing them into per-stream online
+    checkers (jepsen_tpu/service.py). SIGTERM drains gracefully:
+    every stream's carry is checkpointed and a restarted service
+    resumes from the manifests."""
+    def run_service(options):
+        from . import service as _service
+        svc = _service.VerificationService(
+            max_streams=options.get("max_streams", 64),
+            budget_elementops=float(
+                options.get("budget_elementops") or
+                _service.DEFAULT_BUDGET_ELEMENTOPS))
+        bound = svc.serve(options.get("bind") or "127.0.0.1:0")
+        if options.get("watch"):
+            svc.watch(options["watch"])
+            log.info("watching journals under %s", options["watch"])
+        svc.install_sigterm()
+        print(f"Verification service listening on {bound}")
+        try:
+            while not svc.drained.is_set():
+                _time.sleep(0.5)
+        except KeyboardInterrupt:
+            svc.drain()
+        svc.stop()
+
+    return {"service": {
+        "opt_spec": [
+            opt("--bind", "-b", default="127.0.0.1:0", metavar="ADDR",
+                help="host:port (port 0 picks a free port) or a unix "
+                     "socket path to listen on"),
+            opt("--watch", metavar="DIR", default=None,
+                help="Also tail-follow journals under this store "
+                     "directory (resumes drained runs from their "
+                     "service manifests)."),
+            opt("--max-streams", type=int, default=64, metavar="N",
+                help="Admission cap on concurrently attached runs."),
+            opt("--budget-elementops", type=float, default=None,
+                metavar="N",
+                help="Global in-flight chunk budget in cost-model "
+                     "element-ops (OOM faults halve it at runtime)."),
+        ],
+        "usage": "Runs the persistent verification service",
+        "run": run_service,
+    }}
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     logging.basicConfig(level=logging.INFO)
-    run(serve_cmd(), argv)
+    run({**serve_cmd(), **service_cmd()}, argv)
 
 
 if __name__ == "__main__":

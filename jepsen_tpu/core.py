@@ -533,8 +533,19 @@ def run(test: dict) -> dict:
 def _maybe_online(test: dict):
     """The streaming/online checker for a test that asked for one, or
     None — never raises: online checking is an optimization and its
-    setup failing must not kill the run."""
+    setup failing must not kill the run. A test with a 'service'
+    address (CLI --service) attaches to the persistent verification
+    service instead of spawning an in-process OnlineChecker; a
+    refused/unreachable service falls back to the local online path
+    when the test also asked for 'online', else to plain offline."""
     try:
+        if test.get("service"):
+            from . import service as _service
+            sc = _service.maybe_attach(test)
+            if sc is not None:
+                return sc
+            if not test.get("online"):
+                return None
         from .checker import streaming
         return streaming.maybe_online(test)
     except Exception:  # noqa: BLE001

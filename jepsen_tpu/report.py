@@ -63,6 +63,30 @@ def tier_line(results: dict) -> str:
     return ""
 
 
+def service_line(status: dict) -> str:
+    """One printable line summarizing a verification service's status
+    (the /healthz shape from service.VerificationService.status), or
+    '' for anything else — for operator logs and the web index."""
+    st = status or {}
+    streams = st.get("streams")
+    if not isinstance(streams, dict):
+        return ""
+    by_state: dict = {}
+    for s in streams.values():
+        by_state[s.get("state", "?")] = \
+            by_state.get(s.get("state", "?"), 0) + 1
+    parts = [f"{n} {state}" for state, n in sorted(by_state.items())]
+    line = (f"service {st.get('state', '?')}: "
+            f"{', '.join(parts) if parts else 'no streams'}")
+    budget = st.get("budget") or {}
+    if budget.get("initial"):
+        line += (f"; budget {budget.get('capacity', 0):.3g}/"
+                 f"{budget['initial']:.3g}")
+        if budget.get("ooms"):
+            line += f" ({budget['ooms']} OOM backpressure events)"
+    return line
+
+
 @contextlib.contextmanager
 def to(filename: str, tee: bool = True):
     """Context manager: stdout inside the block is written to filename

@@ -1,0 +1,1285 @@
+"""The persistent verification service: many runs, one device.
+
+Per-run checking (offline `analyze`, or the per-run `OnlineChecker`)
+ties a checker's lifetime to a run's. A serving deployment inverts
+that: one long-lived daemon owns the device, and many concurrent runs
+hand it their journal streams — over a local socket (`jepsen-tpu
+service`, `run --service ADDR`), or by the service tail-following
+journals under a store directory (`--watch`). P-compositionality
+(arXiv 1504.00204) is why multiplexing wins: histories decompose into
+many small independent projections, so scheduling thousands of small
+streams beats one giant search — and the per-stream machinery already
+exists (`WglStream`/`WrStream`/`ScreenStream` online checkers, the
+recovery ladder, carry checkpoints). This module is the serving layer
+that makes them safe to share:
+
+  * **Per-stream fault isolation.** Each stream runs on its own
+    worker; a classified backend fault climbs that stream's
+    `_RecoveryTrail` and restores its own carry checkpoint (the
+    machinery inside `WglStream`) without stalling siblings, and an
+    *unclassified* exception quarantines only that stream
+    (``degraded`` with the error attached) — the journal remains, so
+    offline `analyze` still covers it.
+  * **Cost-model scheduling.** Chunk dispatch across streams flows
+    through a global element-op budget priced by `wgl.select_engine`:
+    each stream's chunk acquires its modeled cost before dispatching,
+    so cheap streams interleave freely while an expensive one cannot
+    monopolize the device.
+  * **Admission control + OOM-aware backpressure.** Per-stream op
+    queues are bounded; attach is refused past ``max_streams``; a
+    stream whose queue stays saturated past ``shed_timeout_s`` is
+    *shed* — it gets a ``deferred`` verdict (written into its run's
+    store dir) and offline `analyze` picks it up from its journal.
+    Any stream's OOM fault halves the global budget (restored
+    gradually by clean chunks), so one stream's memory pressure
+    throttles the whole service before the backend does.
+  * **Graceful drain.** SIGTERM (or `drain()`) stops admissions,
+    checkpoints every stream's carry, and writes a resume manifest +
+    partial verdicts into each run's store dir
+    (`store.write_service_resume`); a restarted service `resume()`s
+    from the checkpoints — the journal re-feeds the encoder, dispatch
+    skips row-for-row up to the restored carry, and the final verdict
+    is identical to an uninterrupted service's (pinned by
+    tests/test_service.py).
+  * **Status.** `status()` (socket ``{"type": "status"}`` — the
+    /healthz shape) reports per-stream state, queue depths, recovery
+    and attestation-failure counts, and the budget level.
+
+Stream lifecycle::
+
+    admitted ──▶ streaming ──▶ verdict
+                    │ ▲
+         ┌──────────┼─┴─ recovering (stream's own ladder; siblings
+         │          │                unaffected)
+         │          ├──▶ quarantined (unclassified exception;
+         │          │                 'degraded' + error)
+         │          ├──▶ shed        (backpressure; 'deferred',
+         │          │                 analyze covers from journal)
+         │          └──▶ drained     (SIGTERM; checkpoint + manifest,
+         │                            resume() continues to verdict)
+         └─ admission refused (saturated): never admitted, run falls
+            back to its local online/offline checking
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import queue as _queue
+import signal as _signal
+import socket as _socket
+import threading
+import time as _time
+import traceback
+from typing import Callable
+
+from . import store
+
+log = logging.getLogger(__name__)
+
+# stream lifecycle states (see module docstring)
+ADMITTED = "admitted"
+STREAMING = "streaming"
+RECOVERING = "recovering"
+QUARANTINED = "quarantined"
+SHED = "shed"
+DRAINED = "drained"
+VERDICT = "verdict"
+
+DEFAULT_MAX_STREAMS = 64
+DEFAULT_QUEUE_OPS = 50_000
+DEFAULT_SHED_TIMEOUT_S = 2.0
+# global in-flight device budget, in select_engine-modeled element-ops
+# (~a dozen default-shape sort chunks); acquire clamps to capacity so
+# a single over-budget chunk always eventually dispatches
+DEFAULT_BUDGET_ELEMENTOPS = 1e9
+# budget restoration per clean chunk, as a fraction of the shortfall
+BUDGET_RESTORE_FRACTION = 0.05
+
+_SEAL = object()
+_CLOSE = object()
+
+
+class AdmissionRefused(Exception):
+    """The service refused a new stream (saturated or draining)."""
+
+
+# ---------------------------------------------------------------------------
+# serializable target specs (client builds, service rebuilds)
+# ---------------------------------------------------------------------------
+
+def _jsonable(v):
+    if isinstance(v, (set, frozenset)):
+        return sorted(v)
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def model_spec(model) -> dict:
+    """A wire-serializable description of a device-model instance
+    (the registered models are flat dataclasses)."""
+    d: dict = {"class": type(model).__name__}
+    if dataclasses.is_dataclass(model):
+        d["fields"] = {f.name: _jsonable(getattr(model, f.name))
+                       for f in dataclasses.fields(model)}
+    return d
+
+
+def model_from_spec(spec: dict):
+    from . import models
+    cls = getattr(models, spec.get("class", ""), None)
+    if not (isinstance(cls, type) and issubclass(cls, models.Model)):
+        raise ValueError(f"unknown model class {spec.get('class')!r}")
+    kw = {}
+    fields = spec.get("fields") or {}
+    for f in dataclasses.fields(cls):
+        if f.name in fields:
+            v = fields[f.name]
+            if "frozenset" in str(f.type) and isinstance(v, list):
+                v = frozenset(v)
+            kw[f.name] = v
+    return cls(**kw)
+
+
+def targets_spec(test: dict) -> dict:
+    """The serializable stream-target spec for a test — the same
+    checker walk `streaming.maybe_online` does, minus the stream
+    construction (the service builds the streams on its side)."""
+    from .checker import screen as _screen
+    from .checker.elle import RWRegisterChecker
+    from .checker.linear import Linearizable
+    from .checker.streaming import (DEFAULT_CHECKPOINT_EVERY,
+                                    DEFAULT_CHUNK_ENTRIES,
+                                    _walk_checkers)
+
+    specs: dict = {}
+    tiered = _screen.tier_is_screen(test.get("tier"))
+    for c in _walk_checkers(test.get("checker")):
+        if tiered and isinstance(c, Linearizable) \
+                and "screen-linear" not in specs:
+            specs["screen-linear"] = {"kind": "screen",
+                                      "model": model_spec(c.model)}
+        if tiered and isinstance(c, RWRegisterChecker) \
+                and not c.additional_graphs \
+                and "screen-wr" not in specs:
+            specs["screen-wr"] = {"kind": "screen-wr",
+                                  "anomalies": sorted(c.anomalies)}
+        if isinstance(c, Linearizable) and "linear" not in specs:
+            if c.model.device_model is None or c.algorithm == "host":
+                continue
+            srange = test.get("online-state-range")
+            specs["linear"] = {
+                "kind": "wgl",
+                "model": model_spec(c.model),
+                "frontier": c.opts.get("frontier", 256),
+                "max-frontier": c.opts.get("max_frontier", 65536),
+                "chunk-entries": test.get("online-chunk-entries",
+                                          DEFAULT_CHUNK_ENTRIES),
+                "engine": "auto" if srange else "sort",
+                "state-range": (list(srange) if srange else None),
+                "concurrency-hint": test.get("concurrency"),
+                "pallas": c.opts.get("pallas"),
+                "checkpoint-every": test.get("online-checkpoint-every",
+                                             DEFAULT_CHECKPOINT_EVERY),
+                "max-recovery-retries": test.get("max-recovery-retries"),
+            }
+        elif isinstance(c, RWRegisterChecker) \
+                and "elle-wr" not in specs:
+            if c.additional_graphs:
+                continue
+            specs["elle-wr"] = {"kind": "wr",
+                                "anomalies": sorted(c.anomalies)}
+    return specs
+
+
+def build_targets(spec: dict, stream_name: str = "",
+                  overrides: dict | None = None) -> dict:
+    """Instantiate stream workers from a targets spec. WGL streams are
+    built service-schedulable (auto_pump=False; the worker pumps under
+    the budget) with a per-stream fault site
+    (``stream-chunk/<name>``) so faults inject and account per
+    stream. `overrides` maps target name -> kernel-shape overrides
+    from a resume checkpoint (slots/chunk/frontier/pallas/engine must
+    match the exported carry)."""
+    from .checker import screen as _screen
+    from .checker.streaming import (DEFAULT_CHECKPOINT_EVERY,
+                                    DEFAULT_CHUNK_ENTRIES, WglStream,
+                                    WrStream)
+
+    out: dict = {}
+    for name, ts in spec.items():
+        kind = ts.get("kind")
+        if kind == "wgl":
+            ov = (overrides or {}).get(name) or {}
+            srange = ov.get("state-range", ts.get("state-range"))
+            out[name] = WglStream(
+                model_from_spec(ts["model"]),
+                slots=ov.get("p", ts.get("slots")),
+                frontier=ov.get("frontier", ts.get("frontier", 256)),
+                max_frontier=ts.get("max-frontier", 65536),
+                chunk_entries=ov.get("chunk",
+                                     ts.get("chunk-entries",
+                                            DEFAULT_CHUNK_ENTRIES)),
+                engine=ov.get("engine", ts.get("engine", "sort")),
+                state_range=(tuple(srange) if srange else None),
+                concurrency_hint=ts.get("concurrency-hint"),
+                pallas=ov.get("pallas", ts.get("pallas")),
+                checkpoint_every=ts.get("checkpoint-every",
+                                        DEFAULT_CHECKPOINT_EVERY),
+                max_recovery_retries=ts.get("max-recovery-retries"),
+                auto_pump=False,
+                fault_site=(f"stream-chunk/{stream_name}"
+                            if stream_name else "stream-chunk"),
+            )
+        elif kind == "wr":
+            out[name] = WrStream(anomalies=ts.get("anomalies"))
+        elif kind == "screen":
+            out[name] = _screen.ScreenStream(
+                model_from_spec(ts["model"]))
+        elif kind == "screen-wr":
+            out[name] = _screen.WrScreen(anomalies=ts.get("anomalies"))
+        else:
+            raise ValueError(f"unknown target kind {kind!r}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the global chunk budget (cost-model scheduling + OOM backpressure)
+# ---------------------------------------------------------------------------
+
+class ChunkBudget:
+    """A weighted semaphore over `wgl.select_engine`-modeled
+    element-ops: each stream acquires its chunk's modeled cost before
+    dispatching. Cheap chunks interleave many-at-a-time; an expensive
+    stream serializes against the budget instead of monopolizing the
+    device. An OOM anywhere halves capacity (backpressure for the
+    whole service); clean chunks restore it gradually."""
+
+    def __init__(self, capacity: float = DEFAULT_BUDGET_ELEMENTOPS):
+        self.initial = float(capacity)
+        self.capacity = float(capacity)
+        self._avail = float(capacity)
+        self._cv = threading.Condition()
+        self.ooms = 0
+
+    def acquire(self, cost: float, timeout_s: float | None = None,
+                cancel: Callable[[], bool] | None = None) -> bool:
+        cost = max(float(cost), 1.0)
+        deadline = (None if timeout_s is None
+                    else _time.monotonic() + timeout_s)
+        with self._cv:
+            while self._avail < min(cost, self.capacity):
+                if cancel is not None and cancel():
+                    return False
+                wait = 0.1
+                if deadline is not None:
+                    wait = min(wait, deadline - _time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._cv.wait(wait)
+            self._avail -= min(cost, self.capacity)
+            return True
+
+    def release(self, cost: float, clean: bool = True) -> None:
+        cost = max(float(cost), 1.0)
+        with self._cv:
+            if clean and self.capacity < self.initial:
+                self.capacity = min(
+                    self.initial,
+                    self.capacity + BUDGET_RESTORE_FRACTION
+                    * (self.initial - self.capacity))
+            self._avail = min(self.capacity,
+                              self._avail + min(cost, self.capacity))
+            self._cv.notify_all()
+
+    def note_oom(self) -> None:
+        with self._cv:
+            self.ooms += 1
+            self.capacity = max(self.initial / 64.0, self.capacity / 2)
+            self._avail = min(self._avail, self.capacity)
+            self._cv.notify_all()
+
+    def status(self) -> dict:
+        with self._cv:
+            return {"initial": self.initial,
+                    "capacity": self.capacity,
+                    "available": self._avail,
+                    "ooms": self.ooms}
+
+
+def chunk_cost(stream) -> tuple[float, str]:
+    """One chunk's modeled element-ops for a WGL stream, priced
+    through `wgl.select_engine` at the stream's actual kernel shape.
+    (cost, reason) — the reason surfaces in status()."""
+    from .checker import wgl
+    srange = stream.state_range or (0, 3)   # undeclared: nominal S=4
+    try:
+        dec = wgl.select_engine(tuple(srange), stream.p, stream.chunk,
+                                slots=stream.p,
+                                frontier=stream.frontier,
+                                pallas=stream.pallas)
+        if stream.engine == "dense":
+            cost = dec.costs["dense"]
+        elif dec.dedup == wgl.DEDUP_PALLAS:
+            cost = dec.costs["hash"]
+        else:
+            cost = dec.costs["sort"]
+        return float(cost), dec.reason
+    except Exception:  # noqa: BLE001 — pricing is advisory
+        return 1e6, "unpriced"
+
+
+# ---------------------------------------------------------------------------
+# one stream's worker
+# ---------------------------------------------------------------------------
+
+class StreamWorker:
+    """One admitted run's verification: a bounded op queue, its stream
+    targets, and a dedicated thread that feeds/pumps them. All device
+    faults stay inside this worker: classified ones climb the
+    stream's own recovery ladder, unclassified ones quarantine the
+    worker."""
+
+    def __init__(self, name: str, spec: dict, service: "VerificationService",
+                 store_dir: str | None = None,
+                 overrides: dict | None = None):
+        self.name = name
+        self.spec = spec
+        self.service = service
+        self.store_dir = store_dir
+        self.state = ADMITTED
+        self.q: _queue.Queue = _queue.Queue(maxsize=service.queue_ops)
+        self.targets = build_targets(spec, stream_name=name,
+                                     overrides=overrides)
+        self.target_names = sorted(self.targets)
+        self._final_chunks: dict = {}
+        self._final_attest_failures = 0
+        self.results: dict = {}
+        self.error: str | None = None
+        self.done = threading.Event()
+        self.violation = False
+        self.ops_fed = 0
+        self.recoveries = 0
+        self.shed_reason: str | None = None
+        self._drain = threading.Event()
+        self._dead_targets: set[str] = set()
+        self._costs = {n: chunk_cost(t)
+                       for n, t in self.targets.items()
+                       if hasattr(t, "pending_chunks")}
+        self.thread = threading.Thread(
+            target=self._run, name=f"jepsen-service-{name}",
+            daemon=True)
+
+    # -- worker thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException:  # noqa: BLE001 — thread boundary
+            self._quarantine(traceback.format_exc())
+        finally:
+            # a long-lived daemon serves thousands of runs: once this
+            # worker is terminal, its streams' step logs and staging
+            # buffers (the whole history, in int32 rows) must not
+            # outlive it — snapshot the status detail, drop the rest
+            self._release_targets()
+
+    def _release_targets(self) -> None:
+        self._final_chunks = self._chunk_status()
+        self._final_attest_failures = self._attest_failures()
+        self.targets = {}
+        self._dead_targets = set()
+
+    def _attest_failures(self) -> int:
+        if not self.targets:
+            return self._final_attest_failures
+        return sum(
+            sum(1 for k in getattr(t, "faults", []) if k == "corrupt")
+            for t in self.targets.values())
+
+    def _chunk_status(self) -> dict:
+        out = dict(self._final_chunks)
+        for name, t in self.targets.items():
+            if hasattr(t, "pending_chunks"):
+                cost, why = self._costs.get(name, (None, ""))
+                out[name] = {
+                    "dispatched": getattr(t, "_chunks", 0),
+                    "pending": (t.pending_chunks()
+                                if name not in self._dead_targets
+                                else 0),
+                    "chunk-syncs": getattr(t, "_chunk_syncs", 0),
+                    "resumed-from-chunk": getattr(
+                        t, "_resumed_from_chunk", None),
+                    "cost-per-chunk": cost,
+                    "engine-reason": why,
+                }
+        return out
+
+    def _loop(self) -> None:
+        sealed = False
+        while True:
+            if self._drain.is_set():
+                self._do_drain()
+                return
+            if self.state in (SHED, QUARANTINED):
+                self._bleed_queue()
+                return
+            try:
+                item = self.q.get(timeout=0.05)
+            except _queue.Empty:
+                item = None
+            fed = 0
+            while item is not None:
+                if item is _CLOSE:
+                    self.state = SHED
+                    self.shed_reason = "client closed"
+                    self.done.set()
+                    return
+                if item is _SEAL:
+                    sealed = True
+                    break
+                self._feed(item)
+                fed += 1
+                if fed >= 4096:
+                    break   # let the pump keep up with a firehose
+                try:
+                    item = self.q.get_nowait()
+                except _queue.Empty:
+                    break
+            self._pump()
+            self._note_violation()
+            if sealed and self.q.empty():
+                self._finish()
+                return
+
+    def _feed(self, op: dict) -> None:
+        if self.state == ADMITTED:
+            self.state = STREAMING
+        self.ops_fed += 1
+        for name, t in self.targets.items():
+            if name in self._dead_targets:
+                continue
+            try:
+                t.feed(op)
+            except Exception as e:  # noqa: BLE001 — containment
+                # a target whose *feed* (host-side encode) breaks is
+                # dropped like OnlineChecker does; offline covers it.
+                # The whole worker quarantines only on errors with no
+                # such containment (thread boundary above).
+                log.warning("service %s: target %r failed at feed "
+                            "(%s); offline checking covers it",
+                            self.name, name, e, exc_info=True)
+                self._dead_targets.add(name)
+        self._note_violation()
+
+    def _note_violation(self) -> None:
+        """Copy the targets' violation flags up (screens flip at feed,
+        WGL streams flip at a chunk sync inside pump — check after
+        both)."""
+        if not self.violation and any(
+                getattr(t, "violation", False)
+                for n, t in self.targets.items()
+                if n not in self._dead_targets):
+            self.violation = True
+
+    def _pump(self) -> None:
+        """Dispatch pending chunks under the global budget — the
+        cost-model scheduling point. One chunk per acquire, so other
+        streams' acquires interleave between our chunks."""
+        for name, t in self.targets.items():
+            if name in self._dead_targets \
+                    or not hasattr(t, "pending_chunks"):
+                continue
+            while t.pending_chunks() > 0 and not self._drain.is_set():
+                cost, _why = self._costs.get(name, (1e6, ""))
+                if not self.service.budget.acquire(
+                        cost, timeout_s=5.0,
+                        cancel=self._drain.is_set):
+                    break
+                n0 = len(t.faults)
+                clean = True
+                try:
+                    t.pump(1)
+                except Exception:  # noqa: BLE001 — unclassified
+                    self.service.budget.release(cost, clean=False)
+                    raise
+                new = t.faults[n0:]
+                if new:
+                    clean = False
+                    self.recoveries += len(new)
+                    self.state = RECOVERING
+                    if any(k == "oom" for k in new):
+                        self.service.budget.note_oom()
+                    # the stream re-priced itself (OOM halves its
+                    # chunk, compile drops pallas): re-price the chunk
+                    self._costs[name] = chunk_cost(t)
+                self.service.budget.release(cost, clean=clean)
+            if self.state == RECOVERING:
+                self.state = STREAMING
+
+    def _finish(self) -> None:
+        out: dict = {}
+        for name, t in self.targets.items():
+            if name in self._dead_targets:
+                continue
+            try:
+                r = t.finish()
+            except RuntimeError:
+                # finish runs its own recovery ladder inside the
+                # stream; an escape here is unclassified
+                self._quarantine(traceback.format_exc())
+                return
+            if r is not None:
+                r.setdefault("history-len", self.ops_fed)
+                out[name] = r
+        self.results = out
+        self.state = VERDICT
+        if self.store_dir:
+            try:
+                store.write_streamed_results(self.store_dir, out)
+                store.clear_service_resume(self.store_dir)
+            except OSError:
+                log.warning("service %s: could not flush verdicts to "
+                            "%s", self.name, self.store_dir,
+                            exc_info=True)
+        self.done.set()
+
+    def _quarantine(self, tb: str) -> None:
+        """Unclassified failure: this stream is done, degraded, with
+        the error attached — and ONLY this stream (the journal is
+        intact; offline analyze covers it)."""
+        self.error = tb
+        self.state = QUARANTINED
+        self.results = dict(self.results)
+        self.results["degraded"] = True
+        self.results["error"] = tb
+        log.warning("service %s: quarantined on unclassified error; "
+                    "siblings unaffected\n%s", self.name, tb)
+        self.done.set()
+
+    def _bleed_queue(self) -> None:
+        try:
+            while True:
+                self.q.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def _do_drain(self) -> None:
+        """Checkpoint every WGL target and persist the resume manifest
+        + any partial verdicts into the run's store dir."""
+        checkpoints: dict = {}
+        for name, t in self.targets.items():
+            if name in self._dead_targets \
+                    or not hasattr(t, "checkpoint_now"):
+                continue
+            try:
+                t.checkpoint_now()
+                ck = t.export_checkpoint()
+                if ck is not None:
+                    checkpoints[name] = ck
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                log.warning("service %s: checkpoint of %r failed at "
+                            "drain; it will resume cold", self.name,
+                            name, exc_info=True)
+        if self.store_dir:
+            try:
+                store.write_service_resume(self.store_dir, {
+                    "stream": self.name,
+                    "targets": self.spec,
+                    "ops-fed": self.ops_fed,
+                    "checkpoints": checkpoints,
+                })
+                if self.results:
+                    store.write_streamed_results(self.store_dir,
+                                                 self.results)
+            except OSError:
+                log.warning("service %s: could not persist the resume "
+                            "manifest", self.name, exc_info=True)
+        self.state = DRAINED
+        self.done.set()
+
+    # -- service-side API --------------------------------------------------
+
+    def offer(self, op: dict, timeout_s: float) -> bool:
+        """Enqueue an op; False (and the stream sheds) when the queue
+        stayed full past timeout_s — the admission-control
+        backpressure rung."""
+        if self.state in (SHED, QUARANTINED, DRAINED):
+            return False
+        try:
+            self.q.put(op, timeout=timeout_s)
+            return True
+        except _queue.Full:
+            self.shed("backpressure: op queue full "
+                      f"({self.service.queue_ops}) for {timeout_s}s")
+            return False
+
+    def seal(self) -> None:
+        self.q.put(_SEAL)
+
+    def shed(self, reason: str) -> None:
+        if self.state in (VERDICT, QUARANTINED, DRAINED, SHED):
+            return
+        self.shed_reason = reason
+        self.state = SHED
+        log.warning("service %s: shed (%s); offline analyze covers "
+                    "it from the journal", self.name, reason)
+        if self.store_dir:
+            try:
+                store.write_streamed_results(
+                    self.store_dir,
+                    {"deferred": True, "reason": reason})
+            except OSError:
+                pass
+        self.done.set()
+
+    def status(self) -> dict:
+        st = {
+            "state": self.state,
+            "queue-depth": self.q.qsize(),
+            "ops-fed": self.ops_fed,
+            "violation": self.violation,
+            "recoveries": self.recoveries,
+            "attest-failures": self._attest_failures(),
+            "targets": self.target_names,
+            "dead-targets": sorted(self._dead_targets),
+        }
+        chunks = self._chunk_status()
+        if chunks:
+            st["chunks"] = chunks
+        if self.shed_reason:
+            st["shed-reason"] = self.shed_reason
+        if self.error:
+            st["error"] = self.error.splitlines()[-1]
+        return st
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+class VerificationService:
+    """See the module docstring. In-process API first (admit / offer /
+    seal / result / shed / drain / resume / status); `serve()` exposes
+    it over a local socket, `watch()` tails a store directory."""
+
+    def __init__(self, max_streams: int = DEFAULT_MAX_STREAMS,
+                 queue_ops: int = DEFAULT_QUEUE_OPS,
+                 shed_timeout_s: float = DEFAULT_SHED_TIMEOUT_S,
+                 budget_elementops: float = DEFAULT_BUDGET_ELEMENTOPS):
+        self.max_streams = max_streams
+        self.queue_ops = queue_ops
+        self.shed_timeout_s = shed_timeout_s
+        self.budget = ChunkBudget(budget_elementops)
+        self.workers: dict[str, StreamWorker] = {}
+        # finished workers kept (newest last) for late status/result
+        # queries; older ones are reaped so a long-lived daemon's
+        # worker table stays bounded
+        self.keep_done = 64
+        self.draining = False
+        self.drained = threading.Event()
+        self.admitted_total = 0
+        self.refused_total = 0
+        self._lock = threading.Lock()
+        self._server: _socket.socket | None = None
+        self._server_threads: list[threading.Thread] = []
+        self._watch_stop = threading.Event()
+        self._watcher: threading.Thread | None = None
+        self._tails: dict[str, tuple] = {}   # run_dir -> (tail, name)
+        self._finished_dirs: set[str] = set()
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, name: str, spec: dict,
+              store_dir: str | None = None,
+              overrides: dict | None = None) -> StreamWorker:
+        with self._lock:
+            if self.draining:
+                self.refused_total += 1
+                raise AdmissionRefused("service is draining")
+            active = sum(1 for w in self.workers.values()
+                         if not w.done.is_set())
+            if active >= self.max_streams:
+                self.refused_total += 1
+                raise AdmissionRefused(
+                    f"saturated: {active} active streams "
+                    f"(max {self.max_streams})")
+            if name in self.workers \
+                    and not self.workers[name].done.is_set():
+                raise AdmissionRefused(f"stream {name!r} already "
+                                       "attached")
+            self._reap_done_locked()
+            w = StreamWorker(name, spec, self, store_dir=store_dir,
+                             overrides=overrides)
+            self.workers[name] = w
+            self.admitted_total += 1
+        w.thread.start()
+        log.info("service: admitted stream %r (targets %s)", name,
+                 sorted(w.targets))
+        return w
+
+    def _reap_done_locked(self) -> None:
+        done = [n for n, w in self.workers.items() if w.done.is_set()]
+        for n in done[:-self.keep_done] if self.keep_done else done:
+            del self.workers[n]
+
+    def offer(self, name: str, op: dict) -> bool:
+        w = self.workers.get(name)
+        if w is None:
+            return False
+        return w.offer(op, self.shed_timeout_s)
+
+    def seal(self, name: str) -> None:
+        w = self.workers.get(name)
+        if w is not None:
+            w.seal()
+
+    def result(self, name: str, timeout_s: float | None = 600.0) -> dict:
+        """Block until the stream's verdicts are in; {} for a stream
+        that was shed/drained (offline covers those)."""
+        w = self.workers.get(name)
+        if w is None:
+            return {}
+        if not w.done.wait(timeout_s):
+            return {}
+        return dict(w.results)
+
+    def shed(self, name: str, reason: str = "operator") -> None:
+        w = self.workers.get(name)
+        if w is not None:
+            w.shed(reason)
+
+    # -- drain / resume ----------------------------------------------------
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Stop admissions, checkpoint every live stream's carry, and
+        persist per-run resume manifests — the SIGTERM path."""
+        with self._lock:
+            if self.draining:
+                self.drained.wait(timeout_s)
+                return
+            self.draining = True
+        log.info("service: draining %d streams",
+                 sum(1 for w in self.workers.values()
+                     if not w.done.is_set()))
+        self._watch_stop.set()
+        for w in list(self.workers.values()):
+            if not w.done.is_set():
+                w._drain.set()
+        deadline = _time.monotonic() + timeout_s
+        for w in list(self.workers.values()):
+            w.done.wait(max(0.0, deadline - _time.monotonic()))
+        self.drained.set()
+        log.info("service: drained")
+
+    def install_sigterm(self) -> None:
+        """SIGTERM → graceful drain (then the serve loop exits)."""
+        def _handler(signum, frame):  # noqa: ARG001
+            log.info("service: SIGTERM — draining")
+            self.drain()
+        _signal.signal(_signal.SIGTERM, _handler)
+
+    def resume(self, run_dir: str) -> str | None:
+        """Re-admit a drained run from its resume manifest: the
+        journal re-feeds from the start and WGL dispatch skips
+        row-for-row up to the restored carry checkpoint. Returns the
+        stream name (now being tailed), or None when the run carries
+        no manifest."""
+        man = store.load_service_resume(run_dir)
+        if man is None:
+            return None
+        name = man.get("stream") or os.path.basename(run_dir)
+        overrides = {}
+        ck_by_target = man.get("checkpoints") or {}
+        for target, ck in ck_by_target.items():
+            overrides[target] = {
+                "p": ck.get("p"), "chunk": ck.get("chunk"),
+                "frontier": ck.get("frontier"),
+                "engine": ck.get("engine"),
+                "pallas": ck.get("pallas"),
+                "state-range": ck.get("state-range"),
+            }
+        w = self.admit(name, man["targets"], store_dir=run_dir,
+                       overrides=overrides)
+        for target, ck in ck_by_target.items():
+            t = w.targets.get(target)
+            if t is not None and hasattr(t, "import_checkpoint"):
+                try:
+                    if t.import_checkpoint(ck):
+                        log.info("service %s: %r resuming from chunk "
+                                 "%d", name, target, ck["chunks"])
+                except (ValueError, KeyError):
+                    log.warning("service %s: bad checkpoint for %r; "
+                                "resuming cold", name, target,
+                                exc_info=True)
+        self._tail_run(run_dir, name)
+        return name
+
+    # -- store watching ----------------------------------------------------
+
+    def watch(self, base_dir: str,
+              spec_fn: Callable[[str], dict | None] | None = None,
+              scan_interval_s: float = 1.0) -> None:
+        """Tail-follow journals under a store directory: every run dir
+        with a journal and no results.json is admitted (spec_fn(run_dir)
+        supplies its targets spec; None skips the run — without a
+        spec_fn only runs with a resume manifest are picked up). Polls
+        back off per-tail with decorrelated jitter while a journal is
+        quiet (store.JournalTail.idle_s), so hundreds of dormant runs
+        cost almost nothing."""
+        self._watch_base = base_dir
+        self._watch_spec_fn = spec_fn
+        self._watch_scan_s = scan_interval_s
+        self._ensure_watcher()
+
+    def _ensure_watcher(self) -> None:
+        if self._watcher is None:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, name="jepsen-service-watch",
+                daemon=True)
+            self._watcher.start()
+
+    def _tail_run(self, run_dir: str, name: str) -> None:
+        jp = os.path.join(run_dir, "journal.jsonl")
+        self._tails[run_dir] = (store.JournalTail(jp), name)
+        self._ensure_watcher()
+
+    def _scan(self) -> None:
+        base = getattr(self, "_watch_base", None)
+        spec_fn = getattr(self, "_watch_spec_fn", None)
+        if base is None or not os.path.isdir(base):
+            return
+        for tname, runs in store.tests(base).items():
+            for start, d in runs.items():
+                if d in self._tails or d in self._finished_dirs:
+                    continue
+                if not os.path.exists(
+                        os.path.join(d, "journal.jsonl")):
+                    continue
+                if os.path.exists(os.path.join(d, "results.json")):
+                    continue
+                if os.path.exists(os.path.join(
+                        d, store.STREAMED_RESULTS_FILE)):
+                    # a service (this one or a predecessor) already
+                    # delivered/deferred this run: re-admitting would
+                    # re-verify the whole history on every scan
+                    self._finished_dirs.add(d)
+                    continue
+                if store.load_service_resume(d) is not None:
+                    try:
+                        self.resume(d)
+                    except AdmissionRefused:
+                        pass
+                    continue
+                if spec_fn is None:
+                    continue
+                spec = spec_fn(d)
+                if not spec:
+                    continue
+                name = f"{tname}/{start}"
+                try:
+                    self.admit(name, spec, store_dir=d)
+                except AdmissionRefused:
+                    continue
+                self._tail_run(d, name)
+
+    def _watch_loop(self) -> None:
+        last_scan = 0.0
+        while not self._watch_stop.is_set():
+            now = _time.monotonic()
+            if now - last_scan >= getattr(self, "_watch_scan_s", 1.0):
+                try:
+                    self._scan()
+                except Exception:  # noqa: BLE001 — keep watching
+                    log.warning("service: store scan failed",
+                                exc_info=True)
+                last_scan = now
+            sleep = 0.25
+            for d, (tail, name) in list(self._tails.items()):
+                w = self.workers.get(name)
+                if w is None or w.done.is_set():
+                    self._tails.pop(d, None)
+                    self._finished_dirs.add(d)
+                    continue
+                if tail.idle_s > 0 and now < getattr(
+                        tail, "_next_poll", 0.0):
+                    sleep = min(sleep, tail._next_poll - now)
+                    continue
+                try:
+                    ops = tail.poll()
+                except ValueError:
+                    w._quarantine(traceback.format_exc())
+                    self._tails.pop(d, None)
+                    continue
+                for op in ops:
+                    self.offer(name, op)
+                if not ops and os.path.exists(
+                        os.path.join(d, "history.jsonl.gz")):
+                    # the run saved its history: the journal is
+                    # complete and fully fed — seal for the verdict
+                    self.seal(name)
+                    self._tails.pop(d, None)
+                    continue
+                # decorrelated-jitter idle backoff (satellite): quiet
+                # journals get polled less and less, any data resets
+                tail._next_poll = _time.monotonic() + tail.idle_s
+                sleep = min(sleep, tail.idle_s or 0.01)
+            self._watch_stop.wait(max(0.005, sleep))
+
+    # -- status ------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The /healthz shape."""
+        with self._lock:
+            workers = dict(self.workers)
+        return {
+            "state": ("drained" if self.drained.is_set()
+                      else "draining" if self.draining else "serving"),
+            "streams": {n: w.status() for n, w in workers.items()},
+            "admitted-total": self.admitted_total,
+            "refused-total": self.refused_total,
+            "shed": sorted(n for n, w in workers.items()
+                           if w.state == SHED),
+            "quarantined": sorted(n for n, w in workers.items()
+                                  if w.state == QUARANTINED),
+            "budget": self.budget.status(),
+        }
+
+    # -- the socket layer --------------------------------------------------
+
+    def serve(self, addr: str = "127.0.0.1:0") -> str:
+        """Listen on a local socket (``host:port``, port 0 picks a
+        free one; a path serves a unix socket). Returns the bound
+        address for clients."""
+        if _is_unix_addr(addr):
+            try:
+                os.unlink(addr)
+            except OSError:
+                pass
+            srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            srv.bind(addr)
+            bound = addr
+        else:
+            host, _, port = addr.rpartition(":")
+            srv = _socket.create_server((host or "127.0.0.1",
+                                         int(port or 0)))
+            bound = "%s:%d" % srv.getsockname()[:2]
+        srv.listen(64)
+        self._server = srv
+        t = threading.Thread(target=self._accept_loop,
+                             name="jepsen-service-accept", daemon=True)
+        t.start()
+        self._server_threads.append(t)
+        log.info("verification service listening on %s", bound)
+        return bound
+
+    def stop(self) -> None:
+        """Hard stop (after drain, or for tests): close the socket and
+        stop watching."""
+        self._watch_stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+            self._server = None
+
+    def _accept_loop(self) -> None:
+        while self._server is not None:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            # daemon thread per connection, deliberately NOT retained:
+            # a serving daemon sees one connection per run, and an
+            # ever-growing thread list is a leak
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             name="jepsen-service-conn",
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: _socket.socket) -> None:
+        stream: str | None = None
+        wlock = threading.Lock()
+
+        def reply(msg: dict, rid) -> None:
+            if rid is not None:
+                msg["id"] = rid
+            data = (json.dumps(msg, default=store._json_default)
+                    + "\n").encode()
+            with wlock:
+                conn.sendall(data)
+
+        try:
+            with conn, conn.makefile("r", encoding="utf-8") as rf:
+                for line in rf:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        msg = json.loads(line)
+                    except ValueError:
+                        reply({"ok": False,
+                               "error": "bad json"}, None)
+                        continue
+                    rid = msg.get("id")
+                    typ = msg.get("type")
+                    if typ == "op":
+                        if stream is not None:
+                            self.offer(stream, msg.get("op") or {})
+                    elif typ == "attach":
+                        try:
+                            w = self.admit(
+                                str(msg.get("stream")),
+                                msg.get("targets") or {},
+                                store_dir=msg.get("store-dir"))
+                            stream = w.name
+                            reply({"ok": True, "stream": stream,
+                                   "targets": sorted(w.targets)}, rid)
+                        except (AdmissionRefused, ValueError) as e:
+                            reply({"ok": False, "deferred": True,
+                                   "error": str(e)}, rid)
+                    elif typ == "poll":
+                        w = (self.workers.get(stream)
+                             if stream is not None else None)
+                        reply({"ok": True,
+                               "violation": bool(w and w.violation),
+                               "state": w.state if w else None}, rid)
+                    elif typ == "finish":
+                        if stream is None:
+                            reply({"ok": False,
+                                   "error": "not attached"}, rid)
+                            continue
+                        self.seal(stream)
+                        w = self.workers.get(stream)
+                        timeout = float(msg.get("timeout-s") or 600.0)
+                        r = self.result(stream, timeout)
+                        reply({"ok": True, "results": r,
+                               "state": w.state if w else None}, rid)
+                    elif typ == "status":
+                        reply({"ok": True,
+                               "status": self.status()}, rid)
+                    elif typ == "close":
+                        if stream is not None:
+                            w = self.workers.get(stream)
+                            if w is not None \
+                                    and not w.done.is_set():
+                                w.q.put(_CLOSE)
+                        return
+                    else:
+                        reply({"ok": False,
+                               "error": f"unknown type {typ!r}"}, rid)
+        except (OSError, ValueError):
+            log.info("service: connection dropped%s",
+                     f" (stream {stream})" if stream else "")
+
+
+def _is_unix_addr(addr: str) -> bool:
+    return os.sep in addr and ":" not in addr
+
+
+# ---------------------------------------------------------------------------
+# the client (core.run attaches through this)
+# ---------------------------------------------------------------------------
+
+POLL_INTERVAL_S = 0.2
+
+
+class ServiceClient:
+    """An `OnlineChecker`-shaped proxy that feeds a remote
+    verification service instead of spawning in-process stream
+    workers: same offer/should_abort/finalize/close surface, so
+    core.run and the interpreter cannot tell the difference."""
+
+    def __init__(self, addr: str, test: dict, spec: dict | None = None):
+        self.addr = addr
+        self.targets = spec if spec is not None else targets_spec(test)
+        if not self.targets:
+            raise ValueError("no streamable checker targets")
+        self.abort_on_violation = bool(test.get("abort-on-violation"))
+        self.aborted = False
+        self.stream = "%s/%s" % (test.get("name", "run"),
+                                 test.get("start-time", os.getpid()))
+        self._sock = _connect(addr)
+        self._rf = self._sock.makefile("r", encoding="utf-8")
+        self._wlock = threading.Lock()
+        self._rid = 0
+        self._replies: dict[int, dict] = {}
+        self._reply_evt = threading.Condition()
+        self._closed = False
+        self._last_poll = 0.0
+        self._reader = threading.Thread(
+            target=self._read_loop, name="jepsen-service-client",
+            daemon=True)
+        self._reader.start()
+        store_dir = (store.dir_name(test)
+                     if test.get("name") and test.get("start-time")
+                     else None)
+        r = self._request({"type": "attach", "stream": self.stream,
+                           "targets": self.targets,
+                           "store-dir": (os.path.abspath(store_dir)
+                                         if store_dir else None)},
+                          timeout_s=30.0)
+        if not (r and r.get("ok")):
+            self.close()
+            raise AdmissionRefused(
+                (r or {}).get("error") or "attach failed")
+        log.info("attached to verification service %s as %r "
+                 "(targets %s)", addr, self.stream,
+                 sorted(self.targets))
+
+    # -- wire --------------------------------------------------------------
+
+    def _send(self, msg: dict) -> None:
+        data = (json.dumps(msg, default=store._json_default)
+                + "\n").encode()
+        with self._wlock:
+            self._sock.sendall(data)
+
+    def _request(self, msg: dict,
+                 timeout_s: float = 30.0) -> dict | None:
+        with self._reply_evt:
+            self._rid += 1
+            rid = self._rid
+        msg["id"] = rid
+        self._send(msg)
+        deadline = _time.monotonic() + timeout_s
+        with self._reply_evt:
+            while rid not in self._replies:
+                wait = deadline - _time.monotonic()
+                if wait <= 0 or self._closed:
+                    return None
+                self._reply_evt.wait(wait)
+            return self._replies.pop(rid)
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._rf:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                rid = msg.get("id")
+                if rid is not None:
+                    with self._reply_evt:
+                        self._replies[int(rid)] = msg
+                        self._reply_evt.notify_all()
+        except (OSError, ValueError):
+            pass
+        with self._reply_evt:
+            self._closed = True
+            self._reply_evt.notify_all()
+
+    # -- OnlineChecker surface ---------------------------------------------
+
+    def offer(self, op: dict) -> None:
+        if self._closed:
+            return
+        try:
+            self._send({"type": "op", "op": op})
+        except OSError:
+            # the service died mid-run: the journal still has
+            # everything; offline checking covers
+            log.warning("verification service connection lost; "
+                        "offline checking will cover this run")
+            self._mark_closed()
+
+    def should_abort(self) -> bool:
+        if self.aborted:
+            return True
+        if not self.abort_on_violation or self._closed:
+            return False
+        now = _time.monotonic()
+        if now - self._last_poll < POLL_INTERVAL_S:
+            return False
+        self._last_poll = now
+        r = self._request({"type": "poll"}, timeout_s=5.0)
+        if r and r.get("violation"):
+            self.aborted = True
+        return self.aborted
+
+    def finalize(self, timeout_s: float | None = 600.0) -> dict:
+        """Seal the stream and collect its verdicts — shaped exactly
+        like OnlineChecker.finalize (deferred/drained streams return
+        {}, so offline checking covers them)."""
+        if self._closed:
+            return {}
+        r = self._request({"type": "finish",
+                           "timeout-s": timeout_s},
+                          timeout_s=(timeout_s or 600.0) + 30.0)
+        self._mark_closed()
+        if not (r and r.get("ok")):
+            log.warning("verification service finish failed; offline "
+                        "checking covers this run")
+            return {}
+        out = r.get("results") or {}
+        state = r.get("state")
+        if state in (SHED, DRAINED):
+            log.warning("verification service %s this run's stream; "
+                        "offline checking covers it",
+                        "shed" if state == SHED else "drained")
+            return {}
+        if out.get("deferred"):
+            return {}
+        return out
+
+    def close(self) -> None:
+        try:
+            self._send({"type": "close"})
+        except OSError:
+            pass
+        self._mark_closed()
+
+    def _mark_closed(self) -> None:
+        with self._reply_evt:
+            self._closed = True
+            self._reply_evt.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _connect(addr: str) -> _socket.socket:
+    if _is_unix_addr(addr):
+        s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        s.connect(addr)
+        return s
+    host, _, port = addr.rpartition(":")
+    s = _socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=10.0)
+    # the connect timeout must not linger: it would also deadline every
+    # later recv, and a run can legitimately go >10s without traffic
+    # (request timeouts are enforced at the _request layer instead)
+    s.settimeout(None)
+    return s
+
+
+def maybe_attach(test: dict):
+    """A ServiceClient for a test with a 'service' address, or None
+    (no streamable targets / service unreachable / admission refused
+    — the run then falls back to its local online/offline checking).
+    Never raises: the service is an optimization."""
+    addr = test.get("service")
+    if not addr:
+        return None
+    try:
+        spec = targets_spec(test)
+        if not spec:
+            log.info("--service: no streamable checker targets; "
+                     "running without the service")
+            return None
+        return ServiceClient(addr, test, spec)
+    except AdmissionRefused as e:
+        log.warning("verification service refused this run (%s); "
+                    "falling back to local checking", e)
+        return None
+    except OSError as e:
+        log.warning("verification service %s unreachable (%s); "
+                    "falling back to local checking", addr, e)
+        return None

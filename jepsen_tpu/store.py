@@ -140,6 +140,11 @@ class Journal:
         self._closed = False
         self._wake = _threading.Event()
         self._subs: list = []
+        # guards subscriber notification: unsubscribe() takes it too,
+        # so unsubscription is SYNCHRONOUS — once it returns, no
+        # callback can still be in flight (an async remove raced the
+        # notify loop's list snapshot and delivered one late op)
+        self._sub_lock = _threading.RLock()
         self._writer = _threading.Thread(
             target=self._write_loop, name="jepsen-journal", daemon=True)
         self._writer.start()
@@ -151,29 +156,37 @@ class Journal:
         thread (the interpreter's scheduler), so it must be cheap: a
         queue push, not a device dispatch. A subscriber that raises is
         dropped, loudly — a broken consumer must never abort the run.
-        Returns an unsubscribe thunk."""
-        self._subs.append(fn)
+        Returns an unsubscribe thunk. Unsubscription is synchronous:
+        the thunk waits out any in-flight delivery (it must not be
+        called while holding a lock the callbacks need), so after it
+        returns fn will never be called again."""
+        with self._sub_lock:
+            self._subs.append(fn)
 
         def unsubscribe() -> None:
-            try:
-                self._subs.remove(fn)
-            except ValueError:
-                pass
+            with self._sub_lock:
+                try:
+                    self._subs.remove(fn)
+                except ValueError:
+                    pass
         return unsubscribe
 
     def append(self, op: dict) -> None:
         if self._closed:
             return
-        for fn in list(self._subs):
-            try:
-                fn(op)
-            except Exception:  # noqa: BLE001 — see subscribe()
-                log.warning("journal subscriber %r failed; dropping it",
-                            fn, exc_info=True)
+        with self._sub_lock:
+            for fn in list(self._subs):
+                if fn not in self._subs:
+                    continue  # unsubscribed by an earlier callback
                 try:
-                    self._subs.remove(fn)
-                except ValueError:
-                    pass
+                    fn(op)
+                except Exception:  # noqa: BLE001 — see subscribe()
+                    log.warning("journal subscriber %r failed; "
+                                "dropping it", fn, exc_info=True)
+                    try:
+                        self._subs.remove(fn)
+                    except ValueError:
+                        pass
         self._buf.append(op)
         if op.get("type") == INFO or op.get("process") == NEMESIS:
             self.flush()
@@ -278,12 +291,38 @@ class JournalTail:
     until the rest of it arrives, so a consumer polling a live journal
     never sees a parse error for an op that is still being written. A
     corrupt line that HAS been completed (newline present) is real
-    damage and raises ValueError, mirroring read_journal."""
+    damage and raises ValueError, mirroring read_journal.
 
-    def __init__(self, path: str):
+    Idle backoff: re-polling a quiet journal at a fixed interval is
+    cheap for one tail and ruinous for a service tailing hundreds of
+    dormant runs. Each empty poll advances `idle_s` down
+    `control.retry.backoff`'s decorrelated-jitter schedule (capped);
+    any poll that returns data (or buffers a torn tail — the writer
+    is mid-line, so it is NOT idle) resets it to zero. Pollers sleep
+    `tail.idle_s` between polls: zero while data flows, jittered up
+    to `idle_cap_s` once the run goes quiet."""
+
+    def __init__(self, path: str, idle_base_s: float = 0.05,
+                 idle_cap_s: float = 1.0, rng=None):
         self.path = path
         self._pos = 0
         self._buf = ""
+        self.idle_s = 0.0
+        self._idle_base_s = idle_base_s
+        self._idle_cap_s = idle_cap_s
+        self._rng = rng
+        self._delays = None
+
+    def _note_idle(self, active: bool) -> None:
+        if active:
+            self.idle_s = 0.0
+            self._delays = None
+            return
+        if self._delays is None:
+            from .control.retry import backoff
+            self._delays = backoff(self._idle_base_s,
+                                   self._idle_cap_s, self._rng)
+        self.idle_s = next(self._delays)
 
     def poll(self) -> list[dict]:
         try:
@@ -292,9 +331,12 @@ class JournalTail:
                 data = fh.read()
                 self._pos = fh.tell()
         except FileNotFoundError:
+            self._note_idle(False)
             return []
         if not data:
+            self._note_idle(False)
             return []
+        self._note_idle(True)
         self._buf += data
         lines = self._buf.split("\n")
         self._buf = lines.pop()   # incomplete tail (or "")
@@ -318,6 +360,98 @@ def load_journal(test) -> History | None:
     if not os.path.exists(p):
         return None
     return read_journal(p)
+
+
+# -- verification-service handoff -------------------------------------------
+#
+# A long-lived verification service (jepsen_tpu/service.py) owns no
+# histories: the run's journal is the source of truth, and the service
+# leaves its own state NEXT TO it so anyone can pick the run up —
+# `analyze` reads streamed-results.json like core.run's in-memory
+# streamed results, and a restarted service resumes device work from
+# resume.json's carry checkpoints instead of recomputing.
+
+SERVICE_SUBDIR = "service"
+STREAMED_RESULTS_FILE = "streamed-results.json"
+
+
+def _service_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, SERVICE_SUBDIR)
+
+
+def write_streamed_results(run_dir: str, results: dict) -> str:
+    """Flush a service's per-run verdicts (complete or partial) into
+    the run's store directory; load_test surfaces them as
+    'streamed-results' so the checkers' reuse guards see exactly what
+    an in-process online run would have stashed."""
+    os.makedirs(run_dir, exist_ok=True)
+    p = os.path.join(run_dir, STREAMED_RESULTS_FILE)
+    with open(p, "w") as fh:
+        json.dump(results, fh, indent=2, default=_json_default)
+    return p
+
+
+def load_streamed_results(run_dir: str) -> dict | None:
+    p = os.path.join(run_dir, STREAMED_RESULTS_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def write_service_resume(run_dir: str, manifest: dict) -> str:
+    """Persist a draining service's resume manifest for one run.
+    Checkpoint entries under manifest['checkpoints'] may carry a
+    'carry' list of arrays; they are split out into .npz files next
+    to resume.json (JSON-ing device carries would be both huge and
+    lossy) and rejoined by load_service_resume."""
+    import numpy as np
+    d = _service_dir(run_dir)
+    os.makedirs(d, exist_ok=True)
+    man = dict(manifest)
+    cks = {}
+    for target, ck in (manifest.get("checkpoints") or {}).items():
+        ck = dict(ck)
+        carry = ck.pop("carry", None)
+        if carry is not None:
+            fn = f"{str(target).replace(os.sep, '_')}.carry.npz"
+            np.savez(os.path.join(d, fn),
+                     *[np.asarray(a) for a in carry])
+            ck["carry-file"] = fn
+        cks[target] = ck
+    man["checkpoints"] = cks
+    p = os.path.join(d, "resume.json")
+    with open(p, "w") as fh:
+        json.dump(man, fh, indent=2, default=_json_default)
+    return p
+
+
+def load_service_resume(run_dir: str) -> dict | None:
+    """The resume manifest for a run, with carry arrays rejoined, or
+    None when no service ever drained here."""
+    import numpy as np
+    p = os.path.join(_service_dir(run_dir), "resume.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as fh:
+        man = json.load(fh)
+    for target, ck in (man.get("checkpoints") or {}).items():
+        fn = ck.pop("carry-file", None)
+        if fn:
+            with np.load(os.path.join(_service_dir(run_dir), fn)) as z:
+                ck["carry"] = [
+                    z[k] for k in sorted(
+                        z.files, key=lambda s: int(s.split("_")[-1]))]
+    return man
+
+
+def clear_service_resume(run_dir: str) -> None:
+    """Drop a consumed resume manifest (a finished resume must not be
+    resumed twice)."""
+    import shutil
+    d = _service_dir(run_dir)
+    if os.path.isdir(d):
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def write_results(test, results: dict) -> str:
@@ -508,4 +642,10 @@ def load_test(d: str) -> dict:
     if os.path.exists(res_path):
         with open(res_path) as fh:
             test["results"] = json.load(fh)
+    sr = load_streamed_results(d)
+    if sr is not None:
+        # a verification service checked this run: its verdicts ride
+        # the same reuse guards as core.run's in-memory streamed
+        # results (analyze adopts covered targets, re-checks the rest)
+        test["streamed-results"] = sr
     return test

@@ -20,7 +20,15 @@ from typing import Any, Callable, Iterable, Sequence
 # Relative time
 # ---------------------------------------------------------------------------
 
-_GLOBAL_ORIGIN: list[int | None] = [None]
+# Active origins, newest last. A stack removed-by-identity (not a
+# saved/restored single slot) so CONCURRENT runs — e.g. several tests
+# feeding one verification service — can't leak a dead run's origin:
+# with save/restore, interleaved exits re-installed a sibling's saved
+# value after that sibling had already finished. Overlapping runs
+# still share the newest origin (op times are per-run relative and
+# the interpreter's workers must see their spawner's origin, so a
+# thread-local can't work here); exits are now always clean.
+_ORIGIN_STACK: list["relative_time"] = []
 
 
 class relative_time:
@@ -29,20 +37,25 @@ class relative_time:
     restores the enclosing origin on exit, like dynamic binding."""
 
     def __enter__(self):
-        self._prev = _GLOBAL_ORIGIN[0]
-        _GLOBAL_ORIGIN[0] = _time.monotonic_ns()
+        self.origin = _time.monotonic_ns()
+        _ORIGIN_STACK.append(self)
         return self
 
     def __exit__(self, *exc):
-        _GLOBAL_ORIGIN[0] = self._prev
+        try:
+            # remove THIS context wherever it sits (identity ==), not
+            # necessarily the top: a concurrent sibling may have
+            # entered after us and still be running
+            _ORIGIN_STACK.remove(self)
+        except ValueError:
+            pass
         return False
 
 
 def relative_time_nanos() -> int:
-    origin = _GLOBAL_ORIGIN[0]
-    if origin is None:
+    if not _ORIGIN_STACK:
         raise RuntimeError("relative_time_nanos called outside relative_time")
-    return _time.monotonic_ns() - origin
+    return _time.monotonic_ns() - _ORIGIN_STACK[-1].origin
 
 
 def ms_to_nanos(ms: float) -> int:

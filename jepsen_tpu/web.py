@@ -55,6 +55,21 @@ def fast_tests(base: str) -> list[dict]:
                     entry["results"] = json.load(f)
             except (OSError, ValueError):
                 entry["results"] = {"valid?": "incomplete"}
+                # an unfinished run a verification service touched:
+                # surface what the service left behind — a deferred
+                # (shed) marker, a resume manifest from a drain, or
+                # already-streamed verdicts awaiting analyze
+                try:
+                    sr = store.load_streamed_results(d)
+                except (OSError, ValueError):
+                    sr = None
+                if isinstance(sr, dict) and sr.get("deferred"):
+                    entry["results"]["service"] = "deferred"
+                elif os.path.exists(os.path.join(
+                        d, store.SERVICE_SUBDIR, "resume.json")):
+                    entry["results"]["service"] = "drained"
+                elif sr:
+                    entry["results"]["service"] = "streamed"
             out.append(entry)
     return out
 
@@ -82,6 +97,12 @@ def recovery_note(r: dict) -> str:
         return " (escalated)"
     if any(s.get("screened") for s in subs):
         return " (screened)"
+    # verification-service outcomes on not-yet-analyzed runs:
+    # shed ('deferred' — analyze covers from the journal), drained
+    # (a resume manifest awaits a restarted service), or streamed
+    # verdicts awaiting adoption
+    if r.get("service"):
+        return f" (service: {r['service']})"
     return ""
 
 

@@ -1,0 +1,720 @@
+"""The persistent verification service (jepsen_tpu/service.py): multi-
+stream multiplexing, per-stream fault isolation, admission control +
+shed, SIGTERM drain + checkpoint resume, the socket protocol, and the
+store satellites (synchronous Journal unsubscribe, JournalTail idle
+backoff, resume manifests).
+
+The isolation contract under test (ISSUE 8 acceptance): with N
+concurrent streams and one injected fault, the siblings' verdicts,
+frontiers, and blame certificates are byte-identical (as canonical
+JSON — every op rides the journal's JSON encoding either way) to solo
+runs, the faulted stream resumes via its own checkpoint, and a
+SIGTERM drain + restart produces verdicts identical to an
+uninterrupted service.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import models, service, store
+from jepsen_tpu.checker import streaming, synth
+
+MODEL = models.cas_register()
+CHUNK = 64
+SLOTS = 8        # sized so no history rebuilds mid-stream (a rebuild
+FRONTIER = 128   # would make attested tallies feed-timing-dependent)
+CKPT = 2         # and small enough that the CPU sort kernel is fast
+
+# keys whose values are process/feed-timing diagnostics, not verdict
+# content ('violation-at-op' counts ops *fed* at detection — a
+# scheduler-timing artifact in a service; the blame certificate
+# itself is deterministic and IS compared)
+TIMING = ("tail-latency-ms", "duration-ms", "violation-at-op")
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_injection():
+    """The kind@site:n injection counters are process-global and keyed
+    by site; each test's clauses must count from zero."""
+    from jepsen_tpu import _platform
+    _platform.reset_fault_injection()
+    yield
+    _platform.reset_fault_injection()
+
+
+def _canon(x):
+    """Canonical JSON form — 'byte-identical' means identical once
+    serialized the way the journal/results serialize everything."""
+    return json.loads(json.dumps(x, default=store._json_default,
+                                 sort_keys=True))
+
+
+def _strip(d, extra=()):
+    return _canon({k: v for k, v in d.items()
+                   if k not in TIMING + tuple(extra)})
+
+
+def _jops(h):
+    """History ops as the journal would deliver them (JSON round-trip:
+    tuples become lists — the wire form both solo and service feeds
+    must share for byte-identity)."""
+    return [json.loads(json.dumps(op, default=store._json_default))
+            for op in h.ops]
+
+
+def _solo(ops, **kw):
+    s = streaming.WglStream(MODEL, chunk_entries=CHUNK, slots=SLOTS,
+                            frontier=FRONTIER, checkpoint_every=CKPT,
+                            **kw)
+    for op in ops:
+        s.feed(op)
+    return s.finish()
+
+
+_HISTS: dict = {}
+
+
+def _hist(seed, n=300, corrupt_seed=None):
+    """Deterministic journal-form history + its solo verdict, cached
+    across tests (the fault matrix reuses the same siblings for every
+    fault kind)."""
+    key = (seed, n, corrupt_seed)
+    if key not in _HISTS:
+        h = synth.register_history(n, concurrency=3, values=5,
+                                   seed=seed)
+        if corrupt_seed is not None:
+            h = synth.corrupt(h, seed=corrupt_seed)
+        ops = _jops(h)
+        _HISTS[key] = (ops, _solo(ops))
+    return _HISTS[key]
+
+
+def _wgl_spec(**over):
+    sp = {"kind": "wgl", "model": service.model_spec(MODEL),
+          "chunk-entries": CHUNK, "slots": SLOTS, "engine": "sort",
+          "frontier": FRONTIER, "checkpoint-every": CKPT}
+    sp.update(over)
+    return sp
+
+
+def _write_journal(run_dir, ops):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "journal.jsonl"), "w") as fh:
+        for op in ops:
+            fh.write(json.dumps(op, default=store._json_default) + "\n")
+
+
+def _write_history_gz(run_dir, ops):
+    with gzip.open(os.path.join(run_dir, "history.jsonl.gz"),
+                   "wt") as fh:
+        for op in ops:
+            fh.write(json.dumps(op, default=store._json_default) + "\n")
+
+
+# -- store satellites -------------------------------------------------------
+
+def test_journal_unsubscribe_is_synchronous(tmp_path):
+    """The pinned race: unsubscribing while append is mid-notify used
+    to deliver one late callback after unsubscribe returned. Now
+    unsubscribe blocks until the in-flight delivery completes, and
+    nothing is delivered afterwards."""
+    j = store.Journal(str(tmp_path / "j.jsonl"))
+    received = []
+    in_notify = threading.Event()
+    gate = threading.Event()
+
+    def fn(op):
+        received.append(op)
+        in_notify.set()
+        gate.wait(5.0)
+
+    unsub = j.subscribe(fn)
+    t = threading.Thread(
+        target=lambda: j.append({"type": "invoke", "process": 0}))
+    t.start()
+    assert in_notify.wait(5.0)
+    # delivery is in flight: unsubscribe must BLOCK, not return with
+    # the callback still running
+    u = threading.Thread(target=unsub)
+    u.start()
+    u.join(0.2)
+    assert u.is_alive(), "unsubscribe returned mid-delivery"
+    gate.set()
+    u.join(5.0)
+    assert not u.is_alive()
+    t.join(5.0)
+    # after unsubscribe returns, no further delivery — ever
+    j.append({"type": "ok", "process": 0})
+    assert len(received) == 1
+    j.close()
+
+
+def test_journal_unsubscribe_from_callback(tmp_path):
+    """A callback unsubscribing a later subscriber in the same notify
+    batch suppresses its delivery (and must not deadlock)."""
+    j = store.Journal(str(tmp_path / "j.jsonl"))
+    got_b = []
+    unsub_b_box = []
+
+    def a(op):
+        unsub_b_box[0]()
+
+    def b(op):
+        got_b.append(op)
+
+    j.subscribe(a)
+    unsub_b_box.append(j.subscribe(b))
+    j.append({"type": "invoke", "process": 0})
+    assert got_b == []
+    j.close()
+
+
+def test_journal_tail_idle_backoff(tmp_path):
+    import random
+
+    p = str(tmp_path / "j.jsonl")
+    tail = store.JournalTail(p, idle_base_s=0.05, idle_cap_s=1.0,
+                             rng=random.Random(7))
+    assert tail.idle_s == 0.0
+    # empty polls back off (decorrelated jitter within [base, cap])
+    delays = []
+    for _ in range(8):
+        assert tail.poll() == []
+        delays.append(tail.idle_s)
+    assert all(0.05 <= d <= 1.0 for d in delays)
+    assert max(delays) > 0.05          # it actually grew
+    # data resets the schedule to zero
+    with open(p, "w") as fh:
+        fh.write('{"type": "invoke", "process": 0}\n')
+    assert len(tail.poll()) == 1
+    assert tail.idle_s == 0.0
+    # a torn tail means the writer is mid-line: NOT idle
+    with open(p, "a") as fh:
+        fh.write('{"type": "ok", "pro')
+    assert tail.poll() == []
+    assert tail.idle_s == 0.0
+    # quiet again: the backoff restarts from base
+    assert tail.poll() == []
+    assert tail.idle_s == 0.05
+
+
+def test_resume_manifest_roundtrip(tmp_path):
+    import numpy as np
+
+    d = str(tmp_path / "run")
+    man = {"stream": "s1", "targets": {"linear": _wgl_spec()},
+           "ops-fed": 42,
+           "checkpoints": {"linear": {
+               "rows": 128, "chunks": 2, "p": 16,
+               "carry": [np.arange(6, dtype=np.int32),
+                         np.ones((2, 3), np.int32)]}}}
+    store.write_service_resume(d, man)
+    back = store.load_service_resume(d)
+    assert back["stream"] == "s1"
+    assert back["ops-fed"] == 42
+    ck = back["checkpoints"]["linear"]
+    assert ck["rows"] == 128 and ck["p"] == 16
+    assert (ck["carry"][0] == np.arange(6)).all()
+    assert (ck["carry"][1] == np.ones((2, 3))).all()
+    store.clear_service_resume(d)
+    assert store.load_service_resume(d) is None
+
+
+def test_streamed_results_flush_and_load_test(tmp_path):
+    d = str(tmp_path / "store" / "t" / "20260101T000000")
+    h = synth.register_history(40, concurrency=3, values=3, seed=1)
+    _write_journal(d, _jops(h))
+    store.write_streamed_results(d, {"linear": {"valid?": True,
+                                                "streamed": True}})
+    t = store.load_test(d)
+    assert t["streamed-results"]["linear"]["valid?"] is True
+
+
+# -- spec round-trips -------------------------------------------------------
+
+def test_model_spec_roundtrip():
+    for m in (models.cas_register(), models.cas_register(0),
+              models.register(3)):
+        assert service.model_from_spec(
+            _canon(service.model_spec(m))) == m
+
+
+def test_targets_spec_walks_checkers():
+    from jepsen_tpu.checker import linearizable
+
+    t = {"checker": linearizable(models.cas_register(0)),
+         "concurrency": 5, "online-chunk-entries": 128}
+    spec = service.targets_spec(t)
+    assert set(spec) == {"linear"}
+    assert spec["linear"]["kind"] == "wgl"
+    assert spec["linear"]["chunk-entries"] == 128
+    # tier screen adds the live screen target
+    t["tier"] = "screen"
+    spec = _canon(service.targets_spec(t))
+    assert set(spec) == {"linear", "screen-linear"}
+    # and the spec survives the wire (JSON) back into live targets
+    targets = service.build_targets(spec, stream_name="x")
+    assert targets["linear"].fault_site == "stream-chunk/x"
+    assert targets["linear"].auto_pump is False
+
+
+def test_external_pump_parity():
+    """auto_pump=False + manual pump() == the auto-pumped stream."""
+    ops = _jops(synth.register_history(300, concurrency=4, values=5,
+                                       seed=21))
+    auto = _solo(ops)
+    s = streaming.WglStream(MODEL, chunk_entries=CHUNK, slots=SLOTS,
+                            frontier=FRONTIER, checkpoint_every=CKPT,
+                            auto_pump=False)
+    for op in ops:
+        s.feed(op)
+    assert s.pending_chunks() > 0
+    while s.pending_chunks():
+        assert s.pump(1) == 1
+    r = s.finish()
+    assert _strip(r) == _strip(auto)
+
+
+# -- multiplexing + isolation ----------------------------------------------
+
+def _run_streams(svc, hists):
+    """Feed each history concurrently through its own stream."""
+    for n in hists:
+        svc.admit(n, {"linear": _wgl_spec()})
+
+    def feed(n):
+        for op in hists[n]:
+            svc.offer(n, op)
+        svc.seal(n)
+
+    ths = [threading.Thread(target=feed, args=(n,)) for n in hists]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    return {n: svc.result(n, timeout_s=300) for n in hists}
+
+
+def test_service_multiplexes_and_matches_solo():
+    hists = {"a": _hist(31)[0], "b": _hist(32, corrupt_seed=5)[0]}
+    solos = {"a": _hist(31)[1], "b": _hist(32, corrupt_seed=5)[1]}
+    svc = service.VerificationService()
+    res = _run_streams(svc, hists)
+    assert solos["a"]["valid?"] is True
+    assert solos["b"]["valid?"] is False
+    for n in hists:
+        assert _strip(res[n]["linear"]) == _strip(solos[n]), n
+    st = svc.status()
+    assert st["state"] == "serving"
+    assert all(s["state"] == "verdict"
+               for s in st["streams"].values())
+    assert st["budget"]["capacity"] == st["budget"]["initial"]
+
+
+@pytest.mark.parametrize("kind,clause", [
+    ("oom", "oom@stream-chunk/r2:3"),
+    ("device-lost", "device-lost@stream-chunk/r2:3"),
+    ("wedged", "wedged@stream-chunk/r2:3"),
+    ("bitflip", "bitflip@stream-chunk/r2:2"),
+])
+def test_service_isolation_fault_matrix(kind, clause, monkeypatch):
+    """ISSUE 8 acceptance: 4 concurrent streams, one injected fault on
+    r2 (per-stream fault site). The 3 siblings — including an invalid
+    one, so blame certificates are compared — are byte-identical to
+    solo runs; r2 recovers through its own ladder/checkpoint and its
+    verdict (minus the recovery/attest trail) matches its solo run
+    too."""
+    seeds = {"r0": (40, None), "r1": (41, 9), "r2": (42, None),
+             "r3": (43, None)}   # r1 invalid: blame must be untouched
+    hists = {n: _hist(sd, corrupt_seed=c)[0]
+             for n, (sd, c) in seeds.items()}
+    solos = {n: _hist(sd, corrupt_seed=c)[1]
+             for n, (sd, c) in seeds.items()}
+    assert solos["r1"]["valid?"] is False
+    assert "op" in solos["r1"]          # the blame certificate
+
+    monkeypatch.setenv("JEPSEN_TPU_FAULT_INJECT", clause)
+    svc = service.VerificationService()
+    res = _run_streams(svc, hists)
+    monkeypatch.delenv("JEPSEN_TPU_FAULT_INJECT")
+
+    for n in ("r0", "r1", "r3"):        # siblings: full byte-identity
+        assert _strip(res[n]["linear"]) == _strip(solos[n]), n
+    r2 = res["r2"]["linear"]
+    rec = r2.get("recovered")
+    assert isinstance(rec, dict), f"r2 did not recover: {r2}"
+    want = "corrupt" if kind == "bitflip" else kind
+    assert want in rec["faults"]
+    assert rec.get("resumed-from-chunk") is not None
+    # the faulted stream's verdict still matches its solo run
+    assert _strip(r2, ("recovered", "attested")) == \
+        _strip(solos["r2"], ("recovered", "attested"))
+    st = svc.status()["streams"]["r2"]
+    assert st["recoveries"] >= 1
+    if kind == "bitflip":
+        assert st["attest-failures"] >= 1
+    if kind == "oom":
+        b = svc.status()["budget"]
+        assert b["ooms"] == 1
+        assert b["capacity"] < b["initial"]
+
+
+def test_service_quarantine_contains_unclassified(tmp_path):
+    """A checker bug (unclassified exception) quarantines ONLY its
+    stream — degraded with the error attached — while a sibling runs
+    to a clean verdict."""
+    good = _hist(42)[0]
+    bad = _hist(43)[0]
+    svc = service.VerificationService()
+    wb = svc.admit("bad", {"linear": _wgl_spec()})
+    svc.admit("good", {"linear": _wgl_spec()})
+
+    def boom(max_chunks=None):
+        raise TypeError("checker bug, not a device fault")
+
+    wb.targets["linear"].pump = boom
+    for n, ops in (("bad", bad), ("good", good)):
+        for op in ops:
+            svc.offer(n, op)
+        svc.seal(n)
+    rb = svc.result("bad", timeout_s=60)
+    rg = svc.result("good", timeout_s=300)
+    assert rb.get("degraded") is True
+    assert "checker bug" in rb.get("error", "")
+    assert rg["linear"]["valid?"] is True
+    st = svc.status()
+    assert st["streams"]["bad"]["state"] == "quarantined"
+    assert st["streams"]["good"]["state"] == "verdict"
+    assert st["quarantined"] == ["bad"]
+
+
+def test_service_shed_backpressure_deferred(tmp_path, monkeypatch):
+    """A stream whose bounded queue stays full past shed_timeout_s is
+    shed: deferred marker in its run dir, empty results (offline
+    analyze covers it from the journal), siblings unaffected."""
+    run_dir = str(tmp_path / "store" / "shed" / "t0")
+    os.makedirs(run_dir)
+    svc = service.VerificationService(queue_ops=4,
+                                      shed_timeout_s=0.3)
+    w = svc.admit("slow", {"linear": _wgl_spec()},
+                  store_dir=run_dir)
+    # wedge the worker so the queue cannot drain
+    monkeypatch.setattr(
+        w, "_feed", lambda op: time.sleep(30))
+    ops = _hist(61)[0]
+    shed = False
+    for op in ops:
+        if not svc.offer("slow", op):
+            shed = True
+            break
+    assert shed
+    assert w.state == service.SHED
+    assert svc.result("slow", timeout_s=10) == {}
+    sr = store.load_streamed_results(run_dir)
+    assert sr["deferred"] is True
+    assert "backpressure" in sr["reason"]
+    # a sibling admitted after the shed still verifies cleanly
+    good = _hist(62)[0]
+    svc.admit("fine", {"linear": _wgl_spec()})
+    for op in good:
+        svc.offer("fine", op)
+    svc.seal("fine")
+    assert svc.result("fine", timeout_s=300)["linear"]["valid?"] \
+        is True
+
+
+def test_service_admission_control():
+    svc = service.VerificationService(max_streams=1)
+    svc.admit("only", {"linear": _wgl_spec()})
+    with pytest.raises(service.AdmissionRefused):
+        svc.admit("more", {"linear": _wgl_spec()})
+    with pytest.raises(service.AdmissionRefused):
+        svc.admit("only", {"linear": _wgl_spec()})   # name collision
+    assert svc.status()["refused-total"] == 2
+    svc.drain()
+    with pytest.raises(service.AdmissionRefused):
+        svc.admit("late", {"linear": _wgl_spec()})
+
+
+# -- drain + resume ---------------------------------------------------------
+
+@pytest.mark.parametrize("seed,corrupt", [(73, False), (74, True)])
+def test_sigterm_drain_then_resume_identical(tmp_path, seed, corrupt):
+    """ISSUE 8 acceptance: SIGTERM mid-stream, then a fresh service
+    resumes from the carry checkpoint manifest to a verdict identical
+    to an uninterrupted run's — for a valid and an invalid (blame)
+    history."""
+    ops, solo = _hist(seed, n=600, corrupt_seed=3 if corrupt else None)
+
+    run_dir = str(tmp_path / "store" / "drain" / "t0")
+    _write_journal(run_dir, ops)
+    svc = service.VerificationService()
+    old = signal.getsignal(signal.SIGTERM)
+    try:
+        svc.install_sigterm()
+        svc.admit("t0", {"linear": _wgl_spec()}, store_dir=run_dir)
+        for op in ops[:len(ops) // 2]:
+            svc.offer("t0", op)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ck = svc.workers["t0"].targets["linear"]._ckpt
+            if ck is not None and svc.workers["t0"].q.empty():
+                break
+            time.sleep(0.02)
+        os.kill(os.getpid(), signal.SIGTERM)   # handler drains
+        assert svc.drained.wait(60)
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    assert svc.status()["streams"]["t0"]["state"] == service.DRAINED
+    man = store.load_service_resume(run_dir)
+    assert man is not None
+    ck = man["checkpoints"]["linear"]
+    assert ck["chunks"] >= 1           # a real carry checkpoint
+
+    # restart: the (now complete) journal re-feeds; dispatch resumes
+    # from the checkpoint instead of recomputing the prefix
+    _write_history_gz(run_dir, ops)
+    svc2 = service.VerificationService()
+    name = svc2.resume(run_dir)
+    assert name == "t0"
+    r = svc2.result(name, timeout_s=300)
+    assert _strip(r["linear"]) == _strip(solo)
+    st = svc2.status()["streams"][name]["chunks"]["linear"]
+    assert st["resumed-from-chunk"] == ck["chunks"]
+    # the prefix really was skipped: fewer live chunk syncs than a
+    # cold run would pay
+    assert st["chunk-syncs"] < solo["chunks"]
+    # the manifest is consumed and the verdicts are flushed for
+    # analyze/load_test pickup
+    assert store.load_service_resume(run_dir) is None
+    assert store.load_test(run_dir)["streamed-results"]["linear"][
+        "valid?"] == r["linear"]["valid?"]
+
+
+def test_watch_admits_tails_and_seals(tmp_path):
+    """Store watching: a run dir with a live journal is admitted via
+    spec_fn, tailed with idle backoff, and sealed to a verdict once
+    history.jsonl.gz lands."""
+    base = str(tmp_path / "store")
+    run_dir = os.path.join(base, "watched", "t1")
+    ops, solo = _hist(81)
+    _write_journal(run_dir, ops[:100])
+
+    svc = service.VerificationService()
+    svc.watch(base, spec_fn=lambda d: {"linear": _wgl_spec()},
+              scan_interval_s=0.05)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not svc.workers:
+        time.sleep(0.02)
+    assert svc.workers, "watcher never admitted the run"
+    name = next(iter(svc.workers))
+    # append the rest of the journal live, then finish the run
+    with open(os.path.join(run_dir, "journal.jsonl"), "a") as fh:
+        for op in ops[100:]:
+            fh.write(json.dumps(op, default=store._json_default)
+                     + "\n")
+    _write_history_gz(run_dir, ops)
+    r = svc.result(name, timeout_s=300)
+    assert _strip(r["linear"]) == _strip(solo)
+    assert store.load_streamed_results(run_dir)["linear"]["valid?"] \
+        is True
+    svc.stop()
+
+
+# -- the socket layer -------------------------------------------------------
+
+def test_socket_protocol_and_status(tmp_path):
+    import socket as _socket
+
+    ops, solo = _hist(91)
+    svc = service.VerificationService()
+    addr = svc.serve("127.0.0.1:0")
+    host, _, port = addr.rpartition(":")
+    conn = _socket.create_connection((host, int(port)))
+    rf = conn.makefile("r")
+
+    def req(msg):
+        conn.sendall((json.dumps(msg) + "\n").encode())
+
+    req({"type": "attach", "stream": "s1",
+         "targets": {"linear": _wgl_spec()}, "id": 1})
+    assert json.loads(rf.readline())["ok"] is True
+    for op in ops:
+        req({"type": "op", "op": op})
+    req({"type": "poll", "id": 2})
+    assert json.loads(rf.readline())["violation"] is False
+    req({"type": "status", "id": 3})
+    st = json.loads(rf.readline())["status"]
+    assert "s1" in st["streams"]
+    req({"type": "finish", "id": 4})
+    fin = json.loads(rf.readline())
+    assert fin["state"] == service.VERDICT
+    assert _strip(fin["results"]["linear"]) == _strip(solo)
+    conn.close()
+    svc.stop()
+
+
+def test_service_client_abort_on_violation():
+    bad = _jops(synth.register_history(2000, concurrency=3, values=5,
+                                       seed=92))
+    # make an early read impossible (99 is never written): the stream
+    # confirms a dead frontier within a few chunks, long before the
+    # feed ends
+    for op in bad[200:]:
+        if op.get("type") == "ok" and op.get("f") == "read":
+            op["value"] = 99
+            break
+    svc = service.VerificationService()
+    addr = svc.serve("127.0.0.1:0")
+    t = {"name": "abort", "start-time": "now",
+         "abort-on-violation": True, "store-dir": None}
+    c = service.ServiceClient(addr, t,
+                              spec={"linear": _wgl_spec()})
+    aborted = False
+    for op in bad:
+        c.offer(op)
+        if c.should_abort():
+            aborted = True
+            break
+        time.sleep(0.0005)
+    # the violation may confirm on a chunk boundary after the feed
+    # loop drained — keep polling like the interpreter would
+    deadline = time.monotonic() + 30
+    while not aborted and time.monotonic() < deadline:
+        aborted = c.should_abort()
+        time.sleep(0.05)
+    assert aborted, "violation never surfaced through poll"
+    c.close()
+    svc.stop()
+
+
+def test_refused_attach_falls_back(tmp_path):
+    svc = service.VerificationService(max_streams=0)
+    addr = svc.serve("127.0.0.1:0")
+    from jepsen_tpu.checker import linearizable
+    t = {"name": "x", "start-time": "t", "service": addr,
+         "checker": linearizable(models.cas_register(0)),
+         "concurrency": 4}
+    assert service.maybe_attach(t) is None   # refused, no raise
+    t["service"] = "127.0.0.1:1"             # nothing listens here
+    assert service.maybe_attach(t) is None   # unreachable, no raise
+    svc.stop()
+
+
+# -- CI smoke: two concurrent fake-etcd runs over a real socket -------------
+
+def test_two_concurrent_fake_etcd_runs_through_service(tmp_path):
+    import random
+
+    from fake_etcd import FakeEtcd
+
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    from jepsen_tpu import core, generator as gen
+    from jepsen_tpu.checker import linearizable
+    from jepsen_tpu.suites import etcd
+
+    svc = service.VerificationService()
+    addr = svc.serve("127.0.0.1:0")
+
+    fakes = [FakeEtcd(), FakeEtcd()]
+    for f in fakes:
+        f.port = f.start()
+
+    def make_test(i, fake):
+        rng = random.Random(1000 + i)
+        return {
+            "name": f"etcd-service-smoke-{i}",
+            "nodes": ["n1", "n2", "n3"],
+            "ssh": {"dummy": True},
+            "db": jepsen_tpu.db.noop,
+            "os": jepsen_tpu.os_.noop,
+            "client": etcd.EtcdClient(),
+            "client-url-fn":
+                lambda node: f"http://127.0.0.1:{fake.port}",
+            "concurrency": 4,
+            "store-dir": str(tmp_path / "store"),
+            # single-register mode: scalar values land on key 'r'
+            "checker": linearizable(models.cas_register()),
+            "service": addr,
+            "online-chunk-entries": CHUNK,
+            "generator": gen.clients(gen.limit(150, gen.mix([
+                lambda: {"f": "read"},
+                lambda: {"f": "write",
+                         "value": rng.randint(0, 4)},
+                lambda: {"f": "cas",
+                         "value": [rng.randint(0, 4),
+                                   rng.randint(0, 4)]},
+            ]))),
+        }
+
+    done: dict = {}
+
+    def run_one(i, fake):
+        done[i] = core.run(make_test(i, fake))
+
+    ths = [threading.Thread(target=run_one, args=(i, f))
+           for i, f in enumerate(fakes)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(300)
+    for f in fakes:
+        f.stop()
+    assert sorted(done) == [0, 1]
+    for i in (0, 1):
+        res = done[i]["results"]
+        assert res["valid?"] is True, res
+        # the verdict came from the service stream, not an offline
+        # re-check
+        assert res.get("streamed") is True
+        assert done[i]["streamed-results"]["linear"]["valid?"] is True
+    st = svc.status()
+    assert len(st["streams"]) == 2
+    assert all(s["state"] == service.VERDICT
+               for s in st["streams"].values())
+    svc.stop()
+
+
+# -- CLI / surfacing --------------------------------------------------------
+
+def test_cli_has_service_command_and_option():
+    from jepsen_tpu import cli
+
+    cmds = cli.service_cmd()
+    assert "service" in cmds
+    longs = [o["long"] for o in cmds["service"]["opt_spec"]]
+    assert "--bind" in longs and "--watch" in longs
+    assert any(o["long"] == "--service"
+               for o in cli.test_opt_spec())
+
+
+def test_report_service_line_and_web_note(tmp_path):
+    from jepsen_tpu import report, web
+
+    line = report.service_line({
+        "state": "serving",
+        "streams": {"a": {"state": "streaming"},
+                    "b": {"state": "verdict"}},
+        "budget": {"initial": 1e9, "capacity": 5e8, "ooms": 1}})
+    assert "1 streaming" in line and "1 verdict" in line
+    assert "OOM" in line
+    assert report.service_line({}) == ""
+    # web: a shed run surfaces its deferred marker on the index
+    base = str(tmp_path / "store")
+    d = os.path.join(base, "shedded", "t0")
+    os.makedirs(d)
+    store.write_streamed_results(d, {"deferred": True,
+                                     "reason": "backpressure"})
+    rows = web.fast_tests(base)
+    assert rows[0]["results"]["service"] == "deferred"
+    assert "(service: deferred)" in web.recovery_note(
+        rows[0]["results"])

@@ -145,6 +145,24 @@ def test_relative_time_nesting():
         assert util.relative_time_nanos() >= 0
 
 
+def test_relative_time_interleaved_exits_do_not_leak():
+    """Concurrent runs (e.g. several tests feeding one verification
+    service) interleave enter/exit; the earlier-entered context
+    exiting first must not re-install its saved state over the
+    still-running sibling — and once BOTH have exited, no origin may
+    remain (the old save/restore slot leaked the first context's
+    origin here, so code outside any run silently got timestamps)."""
+    a = util.relative_time()
+    b = util.relative_time()
+    a.__enter__()
+    b.__enter__()
+    a.__exit__(None, None, None)      # a exits while b still runs
+    assert util.relative_time_nanos() >= 0   # b's origin still active
+    b.__exit__(None, None, None)
+    with pytest.raises(RuntimeError):
+        util.relative_time_nanos()
+
+
 def test_majority_and_quantile():
     assert util.majority(5) == 3
     assert util.majority(4) == 3
