@@ -8,17 +8,20 @@ PY ?= python
 
 lint:
 	$(PY) tools/lint.py
+	$(PY) tools/lint_metrics.py
 	$(PY) -m compileall -q jepsen_tpu tests tools bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
 
-# fast pre-gate: just the tier-1 screen + ABFT attestation suites
-# (seconds, no kernel compiles beyond the small fault matrices) — run
-# before the full tier-1 sweep so a broken screen/attestation layer
-# fails in the first minute, not the fortieth. CI runs this first.
+# fast pre-gate: the tier-1 screen + ABFT attestation suites plus the
+# telemetry registry/exposition suite (seconds, no kernel compiles
+# beyond the small fault matrices) — run before the full tier-1 sweep
+# so a broken screen/attestation/observability layer fails in the
+# first minute, not the fortieth. CI runs this first.
 tier0:
-	$(PY) -m pytest tests/test_screen.py tests/test_attest.py -q
+	$(PY) -m pytest tests/test_screen.py tests/test_attest.py \
+		tests/test_telemetry.py -q
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
 # holds the larger shape sweeps, e.g. the pallas dedup parity sweep).

@@ -901,6 +901,108 @@ def section_service():
     }}
 
 
+def section_telemetry():
+    """Instrumentation overhead: the chunked 10k-op WGL path with the
+    metrics registry on vs off, pinned to the CPU backend (the
+    overhead contract is host-side bookkeeping — per-chunk histogram
+    observes, engine-decision counters — and must stay under 2% of
+    the checking path it instruments; doc/observability.md documents
+    the budget). Also reports the registry's primitive micro-costs."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.checker import synth
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    model = _model()
+    # the headline shape (near-zero crash rate — a crashed-write pileup
+    # would measure the adversarial search, not the bookkeeping)
+    hist = synth.register_history(N_OPS, concurrency=CONCURRENCY,
+                                  values=5, crash_rate=0.0005,
+                                  seed=45100)
+    # small chunks -> many instrumented chunk boundaries: the shape
+    # that maximizes per-chunk bookkeeping relative to device work
+    kw = dict(chunk_entries=256)
+    a = analysis_tpu(model, hist, budget_s=420, **kw)  # warm compile
+    assert a["valid?"] is True, f"benchmark history must verify: {a}"
+    # Interleaved min-floor estimator: per-run wall time on a shared
+    # host is ~5%-sigma noisy, but the FLOOR (best observed run) is
+    # stable to well under 1% — so compare min-of-N on vs min-of-N
+    # off, sampled alternately so drift hits both arms. When the
+    # first round still reads over threshold, a second round folds in
+    # (legitimate for a floor estimator: more samples only sharpen
+    # the min, they cannot manufacture a pass).
+    prev = telemetry.set_enabled(True)
+    on_s = off_s = float("inf")
+
+    def sample_pairs(n):
+        # each timed sample is 3 back-to-back analyses (~0.9 s): a
+        # ~10 ms scheduler/GC spike then costs ~1% of a sample
+        # instead of ~4%, which is what makes the floor sharp enough
+        # for a 2% assertion on a shared host
+        nonlocal on_s, off_s
+        for _ in range(n):
+            telemetry.set_enabled(True)
+            t0 = time.monotonic()
+            for _i in range(3):
+                analysis_tpu(model, hist, **kw)
+            on_s = min(on_s, time.monotonic() - t0)
+            telemetry.set_enabled(False)
+            t0 = time.monotonic()
+            for _i in range(3):
+                analysis_tpu(model, hist, **kw)
+            off_s = min(off_s, time.monotonic() - t0)
+
+    try:
+        sample_pairs(15)
+        if (on_s - off_s) / off_s * 100.0 >= 2.0:
+            sample_pairs(15)
+    finally:
+        # restore what the operator configured (JEPSEN_TPU_METRICS=0
+        # must survive this section), not a hardcoded True
+        telemetry.set_enabled(prev)
+    overhead_pct = round((on_s - off_s) / off_s * 100.0, 2)
+
+    # registry primitive costs (ns/op), for the doc catalog —
+    # measured with the registry ON regardless of what the section
+    # restored above (with JEPSEN_TPU_METRICS=0 these loops would
+    # otherwise time the no-op path and misreport it as the real
+    # locked-increment cost), and against a PRIVATE registry so 200k
+    # synthetic samples never pollute the real wgl series this
+    # section snapshots into the BENCH artifact
+    prev_prim = telemetry.set_enabled(True)
+    reg = telemetry.Registry()
+    c = reg.register(telemetry.Counter,
+                     "jepsen_tpu_run_prim_total", "micro-bench",
+                     ("site",)).labels(site="bench")
+    h = reg.register(telemetry.Histogram,
+                     "jepsen_tpu_run_prim_seconds", "micro-bench",
+                     ("site", "family")) \
+        .labels(site="bench", family="sort")
+    n_prim = 200_000
+    t0 = time.monotonic()
+    for _ in range(n_prim):
+        c.inc()
+    counter_ns = (time.monotonic() - t0) / n_prim * 1e9
+    t0 = time.monotonic()
+    for _ in range(n_prim):
+        h.observe(0.001)
+    observe_ns = (time.monotonic() - t0) / n_prim * 1e9
+    telemetry.set_enabled(prev_prim)
+
+    assert overhead_pct < 2.0, \
+        f"telemetry overhead {overhead_pct}% >= 2% on the CPU path"
+    return {"telemetry_overhead": {
+        "on_s": round(on_s, 4), "off_s": round(off_s, 4),
+        "overhead_pct": overhead_pct,
+        "chunk_entries": kw["chunk_entries"],
+        "counter_inc_ns": round(counter_ns, 1),
+        "histogram_observe_ns": round(observe_ns, 1),
+    }}
+
+
 def section_generator():
     """Generator throughput, host-only (reference: >20k ops/s
     single-thread, generator.clj:66-70)."""
@@ -936,6 +1038,7 @@ SECTIONS = [
     ("config4", section_config4, 900, True),
     ("config5", section_config5, 1200, True),
     ("service", section_service, 600, True),
+    ("telemetry", section_telemetry, 420, False),
     ("generator", section_generator, 180, False),
 ]
 
@@ -948,6 +1051,16 @@ def run_section(name: str) -> int:
     table = {n: f for n, f, _t, _d in SECTIONS}
     table.update(NESTED_SECTIONS)
     out = table[name]()
+    # every section's JSON rides a telemetry snapshot of its own
+    # process — engine decisions, recovery rungs, chunk histograms —
+    # which the orchestrator files under extra.sections[name].telemetry
+    # so BENCH_*.json rounds carry the decision counts alongside the
+    # throughput numbers
+    try:
+        from jepsen_tpu import telemetry
+        out.setdefault("telemetry", telemetry.snapshot(compact=True))
+    except Exception as e:  # noqa: BLE001 — meta must not sink a section
+        _note(f"telemetry snapshot failed: {e}")
     print(json.dumps(out), flush=True)
     return 0
 
@@ -1169,12 +1282,16 @@ def main() -> int:
             continue
         _discard_section_files(name)
         sections_meta[name] = {"seconds": dt}
+        tele = payload.pop("telemetry", None)
+        if tele:
+            sections_meta[name]["telemetry"] = tele
         if name == "headline":
             headline = payload
             extra["wgl_best_s"] = payload["wgl_best_s"]
             extra["wgl_engine"] = payload["wgl_engine"]
             extra["wgl_dedup"] = payload.get("wgl_dedup")
-        elif name in ("adversarial", "streaming", "recovery"):
+        elif name in ("adversarial", "streaming", "recovery",
+                      "telemetry"):
             extra.update(payload)
         elif name.startswith("config") or name == "addgraphs":
             configs.update(payload)

@@ -44,7 +44,17 @@ import functools
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from .._platform import CorruptDeviceResult
+
+_M_VERIFY = _telemetry.counter(
+    "jepsen_tpu_abft_verifications_total",
+    "ABFT digest verifications by kind (steps = staged buffers, "
+    "carry = fetched carries)", ("kind",))
+_M_FAIL = _telemetry.counter(
+    "jepsen_tpu_abft_failures_total",
+    "ABFT attestation mismatches (silent corruption detected)",
+    ("kind",))
 
 _MASK = 0xFFFFFFFF
 # position weight period: coprime-ish to power-of-two shapes so equal
@@ -103,11 +113,20 @@ def digest_device(x):
     return _digest_dev_fn()(x)
 
 
+def note_failure(kind: str) -> None:
+    """Count an attestation failure detected outside verify_* — the
+    kernels' in-carry ``att`` accumulator read at summarize
+    (wgl._check_att), which never fetches a whole carry."""
+    _M_FAIL.labels(kind=kind).inc()
+
+
 def verify_steps(site: str, fetched_digest, expected: int) -> None:
     """Compare a fetched device digest with the host's canonical one;
     raise CorruptDeviceResult on disagreement."""
     got = int(fetched_digest)
+    _M_VERIFY.labels(kind="steps").inc()
     if got != expected:
+        _M_FAIL.labels(kind="steps").inc()
         raise CorruptDeviceResult(
             site, f"staged-buffer digest {got} != host {expected} — "
                   f"the shipped buffer was corrupted in transit")
@@ -167,12 +186,15 @@ def verify_carry(site: str, fetched_digest, carry_host,
     """
     got = int(fetched_digest)
     want = carry_digest_host(carry_host)
+    _M_VERIFY.labels(kind="carry").inc()
     if got != want:
+        _M_FAIL.labels(kind="carry").inc()
         raise CorruptDeviceResult(
             site, f"carry digest {got} != host recompute {want} — the "
                   f"fetched carry differs from the device's")
     att = int(np.asarray(carry_host[att_index]))
     if att != 0:
+        _M_FAIL.labels(kind="carry").inc()
         raise CorruptDeviceResult(
             site, f"in-kernel attestation accumulator = {att} — a "
                   f"frontier/table invariant or dedup digest failed "
@@ -184,5 +206,6 @@ def verify_carry(site: str, fetched_digest, carry_host,
     else:                           # sort frontier: count == sum(valid)
         pop = int(np.asarray(carry_host[3]).sum())
     if count != pop:
+        _M_FAIL.labels(kind="carry").inc()
         raise CorruptDeviceResult(
             site, f"carry count {count} != live population {pop}")

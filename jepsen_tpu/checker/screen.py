@@ -66,10 +66,29 @@ import logging
 import time as _time
 from typing import Any
 
+from .. import telemetry as _telemetry
 from ..history import history as as_history
 from . import UNKNOWN  # noqa: F401  (re-exported result vocabulary)
 
 log = logging.getLogger(__name__)
+
+# -- telemetry (doc/observability.md catalogs these) -------------------------
+# Per-op increments are deliberately avoided on the screen hot path:
+# ops are counted in one batch at finish(), so the O(n) screens stay
+# O(n) work + O(1) bookkeeping.
+_M_SCREENED = _telemetry.counter(
+    "jepsen_tpu_screen_screened_ops_total",
+    "History ops consumed by tier-1 screens", ("screen",))
+_M_SECONDS = _telemetry.histogram(
+    "jepsen_tpu_screen_pass_seconds",
+    "Tier-1 screen wall time, feed to finish", ("screen",))
+_M_VIOL = _telemetry.counter(
+    "jepsen_tpu_screen_violations_total",
+    "Definite tier-1 invariant violations by check", ("check",))
+_M_ESC = _telemetry.counter(
+    "jepsen_tpu_screen_escalations_total",
+    "Tier-1 escalations to the full device search, by reason",
+    ("why",))
 
 # escalate when suspicion reaches this (any definite violation does)
 ESCALATE_THRESHOLD = 1.0
@@ -115,15 +134,18 @@ def should_escalate(screen: dict, sample: float = DEFAULT_SAMPLE,
     sampled fraction scales down as min(1, COST_REF / cost) so the
     audit budget is spent where full checks are cheap."""
     if not screen.get("screenable", True):
+        _M_ESC.labels(why="unscreened-model").inc()
         return True, "unscreened-model"
     s = float(screen.get("suspicion", 0.0))
     if s >= ESCALATE_THRESHOLD:
+        _M_ESC.labels(why="suspicion").inc()
         return True, "suspicion"
     p = float(sample)
     if cost:
         p *= min(1.0, COST_REF / max(float(cost), 1.0))
     k = key if key is not None else screen.get("op-count", 0)
     if sample_decision(int(k), p):
+        _M_ESC.labels(why="sampled").inc()
         return True, "sampled"
     return False, ""
 
@@ -226,6 +248,7 @@ class ScreenStream:
     def _flag(self, check: str, op: dict, **detail) -> None:
         self.violations.append({"check": check, "op": op, **detail})
         self.violation = True
+        _M_VIOL.labels(check=check).inc()
 
     def _is_write(self, op) -> bool:
         return op.get("f") in ("write", "w", "cas", "add", "append",
@@ -342,6 +365,9 @@ class ScreenStream:
 
     def finish(self) -> dict:
         now = _time.monotonic()
+        _M_SCREENED.labels(screen="linear").inc(self.client_ops)
+        if self._t0 is not None:
+            _M_SECONDS.labels(screen="linear").observe(now - self._t0)
         return {
             "screened": True,
             "analyzer": "tier1-screen",
@@ -392,8 +418,11 @@ class WrScreen:
         from .streaming import WrStream
         self._ws = WrStream(anomalies=anomalies)
         self.violation = False
+        self._t0: float | None = None   # first feed, for pass_seconds
 
     def feed(self, op: dict) -> None:
+        if self._t0 is None:
+            self._t0 = _time.monotonic()
         self._ws.feed(op)
         if not self.violation and (
                 self._ws._g1a or self._ws._g1b or self._ws._internal
@@ -427,6 +456,14 @@ class WrScreen:
                                    "sccs": sccs})
         if violations:
             self.violation = True
+            for v in violations:
+                _M_VIOL.labels(check=v["check"]).inc()
+        _M_SCREENED.labels(screen="wr").inc(ws.client_ops_fed)
+        # feed-to-finish, like the linear screen's series — the two
+        # label values of one histogram must stay comparable
+        _M_SECONDS.labels(screen="wr").observe(
+            _time.monotonic() - (self._t0 if self._t0 is not None
+                                 else t0))
         return {
             "screened": True,
             "analyzer": "tier1-screen-wr",

@@ -61,6 +61,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from .. import telemetry as _telemetry
+from .. import trace as _trace
 from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST, FAULT_OOM,
                          attest_enabled, guarded_device_get,
                          maybe_corrupt, maybe_inject_fault)
@@ -84,6 +86,28 @@ DEFAULT_CHECKPOINT_EVERY = 8
 # row resolution states (kind uses history.KIND_* once resolved)
 _UNRESOLVED = -1
 _DROPPED = -2
+
+# -- telemetry (doc/observability.md catalogs these) -------------------------
+_M_CHUNKS = _telemetry.counter(
+    "jepsen_tpu_streaming_chunks_total",
+    "Stream chunks dispatched to the device", ("family",))
+_M_LAG = _telemetry.histogram(
+    "jepsen_tpu_streaming_lag_rows",
+    "Encoded step rows still awaiting dispatch (the stream's lag "
+    "behind the journal tail), observed per chunk",
+    buckets=(16, 64, 256, 1024, 4096, 16384, 65536, 262144))
+_M_CKPT_S = _telemetry.histogram(
+    "jepsen_tpu_streaming_checkpoint_seconds",
+    "Carry-checkpoint fetch + verify latency")
+_M_CKPTS = _telemetry.counter(
+    "jepsen_tpu_streaming_checkpoints_total",
+    "Carry checkpoints stored")
+_M_REBUILDS = _telemetry.counter(
+    "jepsen_tpu_streaming_rebuilds_total",
+    "Stream kernel rebuilds by cause", ("reason",))
+_M_VIOLATIONS = _telemetry.counter(
+    "jepsen_tpu_streaming_violations_total",
+    "Definite violations confirmed mid-stream")
 
 
 class _Row:
@@ -394,6 +418,35 @@ class WglStream:
         # with it so a cross-process resume reports the same totals
         # as an uninterrupted run
         self._ckpt_att = (0, 0)
+        # chunk-level tracing: ONE trace id threads run -> stream ->
+        # chunk -> recovery-retry. The stream span parents to the
+        # caller's current span when one is open (a traced run),
+        # else anchors a fresh trace; finish() stamps the trace id on
+        # the verdict, so a violation resolves to the exact device
+        # chunks that produced it.
+        tr = _trace.tracer()
+        self._span_stream = None
+        self._trace_ctx = None
+        if tr.enabled:
+            parent = tr.context()
+            if not parent.get("trace-id"):
+                parent = tr.new_context()
+            self._span_stream = tr.start_span("wgl.stream",
+                                              parent=parent)
+            self._span_stream.tags["model"] = str(self.name)
+            self._span_stream.tags["engine"] = str(self.engine)
+            self._trace_ctx = self._span_stream.context()
+
+    def end_trace(self, valid=None) -> None:
+        """Record the stream's root span (idempotent). Every terminal
+        path must land here — verdict, disablement, a service shedding
+        or draining the worker — or the already-exported chunk spans
+        point at a parent the collector never receives."""
+        sp, self._span_stream = self._span_stream, None
+        if sp is not None:
+            if valid is not None:
+                sp.tags["valid"] = str(valid)
+            _trace.tracer().finish_span(sp)
 
     @property
     def faults(self) -> list:
@@ -446,9 +499,15 @@ class WglStream:
         self._bufs = [pad.copy(), pad.copy()]
         self._carry = self._k.init_carry(
             jnp.int32(self.model.device_state()))
-        # compile warm-up: consumes nothing, leaves the carry untouched
+        # compile warm-up: consumes nothing, leaves the carry
+        # untouched — and IS the stream's XLA compile, so its wall
+        # time is the execute-vs-compile split's other half
+        t0 = _time.monotonic()
         self._carry = self._k.check_stream_chunk(
             self._bufs[0], jnp.int32(0), self._carry)
+        _wgl._M_COMPILE.labels(
+            family=self.engine, stage="warmup").observe(
+            _time.monotonic() - t0)
         if self._restore_ckpt_pending and self._ckpt is not None:
             # a checkpoint imported from a drained service: seed the
             # carry from it so the refed prefix (skipped row-for-row by
@@ -573,6 +632,15 @@ class WglStream:
         recovery target (the cadence-independent body of
         _maybe_checkpoint — also the drain path of a verification
         service, which checkpoints every stream before exiting)."""
+        # success-only metrics: a failed attempt (attest mismatch,
+        # backend fault) records NEITHER series, so sum/count stays a
+        # true per-checkpoint latency and count matches the counter
+        t0 = _time.monotonic()
+        self._checkpoint_inner()
+        _M_CKPT_S.observe(_time.monotonic() - t0)
+        _M_CKPTS.inc()
+
+    def _checkpoint_inner(self) -> None:
         if self._attest:
             # a checkpoint must be KNOWN GOOD before it becomes the
             # recovery target: verify every staged chunk that fed it,
@@ -610,7 +678,12 @@ class WglStream:
         while True:
             try:
                 if replay:
-                    self._restore_and_replay()
+                    with _trace.tracer().span(
+                            "wgl.stream.recovery-retry",
+                            parent=self._trace_ctx) as sp:
+                        if sp is not None and self.faults:
+                            sp.tags["fault"] = str(self.faults[-1])
+                        self._restore_and_replay()
                     replay = False
                 return fn()
             except RuntimeError as e:
@@ -645,12 +718,13 @@ class WglStream:
         if self.auto_pump:
             self._pump()
 
-    def _rebuild(self, p: int) -> None:
+    def _rebuild(self, p: int, reason: str = "slot-overflow") -> None:
         """Re-encode the full feed with new parameters and replay the
         device search from scratch — the rare recovery path (slot
         overflow beyond the initial estimate, dense range escape).
         Replay is still chunked/async, so it costs one pass of device
         time, not a behavioral change."""
+        _M_REBUILDS.labels(reason=reason).inc()
         p = _wgl._bucket(p, lo=8)
         if p > 256:
             self._failed = _wgl.SlotOverflow(
@@ -725,7 +799,7 @@ class WglStream:
                             "the unpacked sort kernel")
                 self.engine = "sort"
                 self._pack = None
-                self._rebuild(p=self.p)
+                self._rebuild(p=self.p, reason="range-escape")
                 return done
             self._dispatch(arr)
             done += 1
@@ -849,32 +923,49 @@ class WglStream:
             return   # a recovery replay already consumed this slice
         if self._k is None:
             self._setup()
-        maybe_inject_fault(self.fault_site)
-        buf = self._bufs[self._chunks % 2]
-        n = len(arr)
-        buf[:n] = arr
-        if n < self.chunk:
-            buf[n:] = self._pad_row
-        prev = self._carry
-        xj = jnp.asarray(maybe_corrupt(self.fault_site, buf))
-        if self._attest:
-            # enqueue the shipped buffer's device digest; the host
-            # digest comes from the canonical staging buffer BEFORE it
-            # is reused. Verified lagged (at _drain_attest callers) so
-            # the chunk pipeline keeps its one sync per chunk.
-            from . import abft
-            self._att_pending.append(
-                (abft.digest_device(xj), abft.digest_host(buf)))
-        self._carry = self._k.check_stream_chunk(
-            xj, jnp.int32(n), self._carry)
-        self._chunks += 1
-        self._rows_done += n
-        if not self._dead:
-            # one host<->device sync per chunk, one chunk behind: the
-            # flag we block on is the PREVIOUS chunk's output, already
-            # produced while we were encoding this one — the poll
-            # overlaps compute instead of serializing after it
-            self._check_death(prev)
+        t_chunk = _time.monotonic()
+        sp = _trace.tracer().start_span("wgl.stream.chunk",
+                                        parent=self._trace_ctx)
+        if sp is not None:
+            sp.tags["chunk"] = str(self._chunks)
+            sp.tags["rows"] = str(len(arr))
+        try:
+            with _telemetry.profile_section("wgl.stream.chunk"):
+                maybe_inject_fault(self.fault_site)
+                buf = self._bufs[self._chunks % 2]
+                n = len(arr)
+                buf[:n] = arr
+                if n < self.chunk:
+                    buf[n:] = self._pad_row
+                prev = self._carry
+                xj = jnp.asarray(maybe_corrupt(self.fault_site, buf))
+                if self._attest:
+                    # enqueue the shipped buffer's device digest; the
+                    # host digest comes from the canonical staging
+                    # buffer BEFORE it is reused. Verified lagged (at
+                    # _drain_attest callers) so the chunk pipeline
+                    # keeps its one sync per chunk.
+                    from . import abft
+                    self._att_pending.append(
+                        (abft.digest_device(xj), abft.digest_host(buf)))
+                self._carry = self._k.check_stream_chunk(
+                    xj, jnp.int32(n), self._carry)
+                self._chunks += 1
+                self._rows_done += n
+                if not self._dead:
+                    # one host<->device sync per chunk, one chunk
+                    # behind: the flag we block on is the PREVIOUS
+                    # chunk's output, already produced while we were
+                    # encoding this one — the poll overlaps compute
+                    # instead of serializing after it
+                    self._check_death(prev)
+        finally:
+            _trace.tracer().finish_span(sp)
+        _wgl._M_CHUNK.labels(site="stream",
+                             family=self.engine).observe(
+            _time.monotonic() - t_chunk)
+        _M_CHUNKS.labels(family=self.engine).inc()
+        _M_LAG.observe(self.encoder.available())
         self._maybe_checkpoint()
 
     def _drain_attest(self) -> None:
@@ -913,6 +1004,9 @@ class WglStream:
             if not self._dead_overflow:
                 self.violation = True
                 self.violation_at_op = len(self._client_ops)
+                _M_VIOLATIONS.inc()
+                if self._span_stream is not None:
+                    self._span_stream.tags["violation"] = "true"
                 log.warning(
                     "online checker: nonlinearizable prefix detected "
                     "after %d ops (%d steps dispatched)",
@@ -940,7 +1034,16 @@ class WglStream:
 
     def finish(self) -> dict | None:
         """Drain the tail, settle the verdict (escalating overflowed
-        invalids like the offline path), and return the analysis."""
+        invalids like the offline path), and return the analysis.
+        Every exit — verdict or a declined/disabled None — records the
+        stream's root span (end_trace is idempotent), so exported
+        chunk spans never point at a parent the collector lacks."""
+        try:
+            return self._finish_inner()
+        finally:
+            self.end_trace()
+
+    def _finish_inner(self) -> dict | None:
         if self._failed is not None:
             return None
         t_tail = _time.monotonic()
@@ -1055,6 +1158,11 @@ class WglStream:
             out["recovered"] = rec
         if self.violation:
             out["violation-at-op"] = self.violation_at_op
+        if self._trace_ctx is not None:
+            # the verdict names its trace: a violation resolves to the
+            # exact chunk spans (and recovery retries) that decided it
+            out["trace-id"] = self._trace_ctx["trace-id"]
+            self.end_trace(valid=out["valid?"])
         if not ok:
             if overflow:
                 out["error"] = (

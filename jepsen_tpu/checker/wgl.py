@@ -59,6 +59,7 @@ from typing import Callable
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST,
                          FAULT_OOM, attest_enabled, backend_reinit,
                          classify_backend_error, guarded_device_get,
@@ -68,6 +69,35 @@ from ..history import (DeviceEncodingError, F_CAS, F_READ, F_WRITE,
                        encode_ops, history as as_history)
 
 log = logging.getLogger(__name__)
+
+# -- telemetry (doc/observability.md catalogs these) -------------------------
+# Per-chunk latency by dispatch site; the streaming layer observes into
+# the same family (site='stream') so one histogram covers every device
+# chunk the pipeline runs.
+_M_CHUNK = _telemetry.histogram(
+    "jepsen_tpu_wgl_chunk_seconds",
+    "Device chunk dispatch + lagged-sync latency",
+    ("site", "family"))
+_M_COMPILE = _telemetry.histogram(
+    "jepsen_tpu_wgl_compile_seconds",
+    "Kernel build (trace/cache miss) and warm-up compile latency",
+    ("family", "stage"))
+_M_ENGINE = _telemetry.counter(
+    "jepsen_tpu_wgl_engine_decisions_total",
+    "select_engine outcomes by family, dedup engine, and coarse reason",
+    ("family", "dedup", "reason"))
+_M_ELEMENTOPS = _telemetry.counter(
+    "jepsen_tpu_wgl_modeled_elementops_total",
+    "Modeled element-ops of the engines select_engine chose",
+    ("family",))
+_M_RUNGS = _telemetry.counter(
+    "jepsen_tpu_wgl_recovery_rungs_total",
+    "Recovery-ladder rung climbs by classified fault kind and site",
+    ("kind", "site"))
+_M_OPS = _telemetry.counter(
+    "jepsen_tpu_wgl_checked_ops_total",
+    "History ops decided by device-checking entries",
+    ("site",))
 
 # Event kinds (host-side stream construction)
 E_INVOKE = 0
@@ -627,6 +657,11 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
     key order, so verdicts/summaries/blame are identical (the
     downstream phases are order-invariant). Shapes the hash gate
     rejects keep the sort."""
+    # build-latency telemetry lives INSIDE the cached body: lru_cache
+    # only runs it on a miss, so every observed sample is a real build
+    # (a cache_info().misses delta around the call races under the
+    # service's concurrent streams and would record warm hits)
+    t_build = _time.monotonic()
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -934,9 +969,12 @@ def _kernel_cached(model_name: str, F: int, P: int, E: int,
         out = run_range(x, n, local)
         return (out[0] + carry[0],) + tuple(out[1:])
 
-    return Kernel(check, check_batch, check_chunk, check_chunk_batch,
-                  check_stream_chunk, init_carry, summarize,
-                  _mk_digest())
+    k = Kernel(check, check_batch, check_chunk, check_chunk_batch,
+               check_stream_chunk, init_carry, summarize,
+               _mk_digest())
+    _M_COMPILE.labels(family="sort", stage="build").observe(
+        _time.monotonic() - t_build)
+    return k
 
 
 # ---------------------------------------------------------------------------
@@ -995,6 +1033,8 @@ _dense_kernel.cache_clear = _clear_dense_caches
 def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
                          E: int, use_pallas: bool, on_tpu: bool,
                          use_attest: bool = True):
+    # miss-only build timing — see the sort kernel's twin comment
+    t_build = _time.monotonic()
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -1187,9 +1227,12 @@ def _dense_kernel_cached(model_name: str, s_lo: int, S: int, P: int,
         out = run_range(x, n, local)
         return (out[0] + carry[0],) + tuple(out[1:])
 
-    return Kernel(check, check_batch, check_chunk, check_chunk_batch,
-                  check_stream_chunk, init_carry, summarize,
-                  _mk_digest())
+    k = Kernel(check, check_batch, check_chunk, check_chunk_batch,
+               check_stream_chunk, init_carry, summarize,
+               _mk_digest())
+    _M_COMPILE.labels(family="dense", stage="build").observe(
+        _time.monotonic() - t_build)
+    return k
 
 
 DENSE_STATE_CAP = 512  # closure() is O(P * S^2 * C): bound S too
@@ -1266,6 +1309,21 @@ def _family_costs(S: int, p_dense: int, p_sort: int, F: int,
     return {"dense": dense, "sort": srt, "hash": hsh}
 
 
+def _note_engine(dec: "EngineDecision", reason: str) -> "EngineDecision":
+    """Count a select_engine outcome. `reason` is the COARSE bucket
+    (forced | slot-cap | dense-caps | cost-model) — the free-text
+    dec.reason would blow up label cardinality. Also accumulates the
+    chosen engine's modeled element-ops, so rate(elementops)/rate(
+    chunk_seconds) is the pipeline's modeled throughput."""
+    _M_ENGINE.labels(family=dec.family, dedup=dec.dedup,
+                     reason=reason).inc()
+    cost = dec.costs.get("dense") if dec.family == "dense" else \
+        dec.costs.get("hash" if dec.dedup == DEDUP_PALLAS else "sort")
+    if cost:
+        _M_ELEMENTOPS.labels(family=dec.family).inc(float(cost))
+    return dec
+
+
 def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
                   *, slots: int | None = None, frontier: int = 256,
                   engine: str = "auto", dense_slot_cap: int | None = None,
@@ -1298,27 +1356,31 @@ def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
                     f"dense engine requested but the history needs "
                     f"{p_exact} slots, over dense_slot_cap="
                     f"{dense_slot_cap}")
-            return EngineDecision(
+            return _note_engine(EngineDecision(
                 "sort", None, dedup,
                 f"p={p_exact} over dense_slot_cap={dense_slot_cap}",
-                costs)
+                costs), "slot-cap")
         dense = _dense_shape(srange, p_exact)
         if dense is None and engine == "dense":
             raise _dense_caps_error(srange, p_exact)
     if engine == "sort" or dense is None:
         why = ("forced" if engine == "sort"
                else f"S={S} x 2^{p_exact} exceeds the dense caps")
-        return EngineDecision("sort", None, dedup, why, costs)
+        return _note_engine(
+            EngineDecision("sort", None, dedup, why, costs),
+            "forced" if engine == "sort" else "dense-caps")
     if engine == "dense" or \
             costs["dense"] <= DENSE_EXACT_BIAS * sort_cost:
         why = ("forced" if engine == "dense" else
                f"dense {costs['dense']:.3g} <= {DENSE_EXACT_BIAS:g}x "
                f"{dedup} {sort_cost:.3g}")
-        return EngineDecision("dense", dense, DEDUP_NONE, why, costs)
-    return EngineDecision(
+        return _note_engine(
+            EngineDecision("dense", dense, DEDUP_NONE, why, costs),
+            "forced" if engine == "dense" else "cost-model")
+    return _note_engine(EngineDecision(
         "sort", None, dedup,
         f"dense {costs['dense']:.3g} > {DENSE_EXACT_BIAS:g}x "
-        f"{dedup} {sort_cost:.3g}", costs)
+        f"{dedup} {sort_cost:.3g}", costs), "cost-model")
 
 
 # ---------------------------------------------------------------------------
@@ -1377,6 +1439,7 @@ class _RecoveryTrail:
         if kind is None:
             raise exc
         self.faults.append(kind)
+        _M_RUNGS.labels(kind=kind, site=site).inc()
         if len(self.faults) > self.max:
             log.warning("%s: %s fault after %d recovery retries; "
                         "taking the final rung (%s)", site, kind,
@@ -1629,12 +1692,17 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
         else:
             k = _kernel(name, F, slots, E, _pack_params(srange, slots),
                         pallas=pallas)
+        fam = "dense" if dense is not None else "sort"
+        chunk_obs = _M_CHUNK.labels(site="offline", family=fam)
         if steps.n <= chunk_entries:
             # single fused call: init + full search + verdict
             maybe_inject_fault("offline")
-            ok, death, overflow, max_count, att = guarded_device_get(
-                k.check(x, jnp.int32(steps.n), init_state),
-                site="offline check")
+            with chunk_obs.time(), \
+                    _telemetry.profile_section("wgl.offline.check"):
+                ok, death, overflow, max_count, att = \
+                    guarded_device_get(
+                        k.check(x, jnp.int32(steps.n), init_state),
+                        site="offline check")
             _check_att(att, "offline")
         else:
             carry = k.init_carry(init_state)
@@ -1649,11 +1717,15 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
             while e < steps.n:
                 stop = min(e + chunk_entries, steps.n)
                 maybe_inject_fault("offline")
-                nxt = k.check_chunk(x, jnp.int32(stop), carry)
-                prev, carry = carry, nxt
-                e = stop
-                if int(guarded_device_get(prev[-2],
-                                          site="offline liveness")) == 0:
+                t_chunk = _time.monotonic()
+                with _telemetry.profile_section("wgl.offline.chunk"):
+                    nxt = k.check_chunk(x, jnp.int32(stop), carry)
+                    prev, carry = carry, nxt
+                    e = stop
+                    dead = int(guarded_device_get(
+                        prev[-2], site="offline liveness")) == 0
+                chunk_obs.observe(_time.monotonic() - t_chunk)
+                if dead:
                     carry = prev   # frontier died last chunk: definite
                     break
                 # only give up when chunks remain — a search that just
@@ -1694,6 +1766,7 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
             timed_out = True
             break
         F *= 4  # invalid + overflow: the witness may have been dropped
+    _M_OPS.labels(site="offline").inc(len(ops))
     out = {
         "valid?": (True if ok else
                    "unknown" if overflow else False),
@@ -1813,7 +1886,9 @@ def _check_att(att, site: str) -> None:
     attestation is disabled, so the check is unconditional."""
     a = int(np.asarray(att))
     if a != 0:
+        from . import abft
         from .._platform import CorruptDeviceResult
+        abft.note_failure("att")
         raise CorruptDeviceResult(
             site, f"in-kernel attestation accumulator = {a} — a "
                   f"frontier/table invariant or dedup digest failed "
@@ -2147,15 +2222,22 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
         # the device computes — all-dead detection lags one chunk
         # (safe: dead frontiers stay dead) in exchange for overlapping
         # the per-chunk sync with compute
+        chunk_obs = _M_CHUNK.labels(
+            site="batch", family="dense" if dense is not None
+            else "sort")
         while e < n_max:
             stop = min(e + chunk_entries, n_max)
             maybe_inject_fault("batch")
-            nxt = k.check_chunk_batch(
-                x, jnp.asarray(np.minimum(ns, stop)), carry)
-            prev, carry = carry, nxt
-            e = stop
-            if not np.asarray(guarded_device_get(
-                    prev[-2], site="batch liveness")).any():
+            t_chunk = _time.monotonic()
+            with _telemetry.profile_section("wgl.batch.chunk"):
+                nxt = k.check_chunk_batch(
+                    x, jnp.asarray(np.minimum(ns, stop)), carry)
+                prev, carry = carry, nxt
+                e = stop
+                all_dead = not np.asarray(guarded_device_get(
+                    prev[-2], site="batch liveness")).any()
+            chunk_obs.observe(_time.monotonic() - t_chunk)
+            if all_dead:
                 carry = prev   # every frontier died: all definite
                 break
             if e < n_max:
@@ -2175,6 +2257,8 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
         ok, death, overflow, max_count, att = guarded_device_get(
             jax.vmap(k.summarize)(carry), site="batch summarize")
         _check_att(np.asarray(att).sum(), "batch")
+        _M_OPS.labels(site="batch").inc(
+            sum(len(o) for _, o, _ in items))
         counts = np.asarray(carry[-2])
         batch_dedup = (DEDUP_NONE if dense is not None else
                        dedup_engine(frontier, slots,
@@ -2557,9 +2641,13 @@ def _check_batch_sharded_once(model, hists: list, mesh=None,
     per_key = np.zeros(k, bool)
     overflow = np.zeros(k, bool)
     all_ok = True
-    for idx, handles in pending:
+    for gi, (idx, handles) in enumerate(pending):
+        t_fetch = _time.monotonic()
         all_ok_g, ok_g, ov_g, att_g, att = guarded_device_get(
             handles, site="sharded fetch")
+        _M_CHUNK.labels(site="sharded",
+                        family=group_info[gi]["family"]).observe(
+            _time.monotonic() - t_fetch)
         _check_att(np.asarray(att_g)[0], "sharded")
         if att is not None:
             from . import abft
@@ -2567,6 +2655,8 @@ def _check_batch_sharded_once(model, hists: list, mesh=None,
         all_ok &= bool(np.asarray(all_ok_g)[0])
         per_key[idx] = np.asarray(ok_g)[:len(idx)]
         overflow[idx] = np.asarray(ov_g)[:len(idx)]
+    _M_OPS.labels(site="sharded").inc(
+        sum(len(o) for o in all_ops))
     # An 'invalid' under frontier overflow is unsound (the witness config
     # may have been dropped): escalate those keys — together, as one
     # vmapped batch at 4x the frontier (recursing upward), never a

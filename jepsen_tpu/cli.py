@@ -505,6 +505,17 @@ def service_cmd() -> dict:
                 options.get("budget_elementops") or
                 _service.DEFAULT_BUDGET_ELEMENTOPS))
         bound = svc.serve(options.get("bind") or "127.0.0.1:0")
+        msrv = None
+        if options.get("metrics_port") is not None:
+            from . import telemetry
+            mhost = options.get("metrics_host") or "127.0.0.1"
+            msrv = telemetry.serve_metrics(
+                int(options["metrics_port"]), host=mhost,
+                healthz=svc.status)
+            mport = msrv.server_address[1]
+            log.info("metrics on http://%s:%d/metrics "
+                     "(/healthz = service status)", mhost, mport)
+            print(f"Metrics listening on :{mport}/metrics")
         if options.get("watch"):
             svc.watch(options["watch"])
             log.info("watching journals under %s", options["watch"])
@@ -516,6 +527,8 @@ def service_cmd() -> dict:
         except KeyboardInterrupt:
             svc.drain()
         svc.stop()
+        if msrv is not None:
+            msrv.shutdown()
 
     return {"service": {
         "opt_spec": [
@@ -532,6 +545,15 @@ def service_cmd() -> dict:
                 metavar="N",
                 help="Global in-flight chunk budget in cost-model "
                      "element-ops (OOM faults halve it at runtime)."),
+            opt("--metrics-port", type=int, default=None, metavar="P",
+                help="Serve Prometheus metrics at :P/metrics and the "
+                     "service status() JSON at :P/healthz (port 0 "
+                     "picks a free one). Unset = no HTTP listener; "
+                     "the socket 'metrics' verb still answers."),
+            opt("--metrics-host", default="127.0.0.1", metavar="HOST",
+                help="Interface for --metrics-port (default loopback, "
+                     "matching --bind's posture; use 0.0.0.0 to let a "
+                     "remote Prometheus scrape)."),
         ],
         "usage": "Runs the persistent verification service",
         "run": run_service,

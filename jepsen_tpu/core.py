@@ -390,6 +390,10 @@ def log_results(test: dict) -> dict:
                  len(scr), len(esc), f" — {detail}" if detail else "")
     if att:
         log.info("ABFT attestation passed on %s", sorted(att))
+    from . import report
+    tl = report.telemetry_line(results)
+    if tl:
+        log.info(tl)
     return test
 
 

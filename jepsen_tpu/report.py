@@ -63,6 +63,31 @@ def tier_line(results: dict) -> str:
     return ""
 
 
+def telemetry_line(results: dict) -> str:
+    """One printable line summarizing a run's pipeline telemetry —
+    device chunks, tier-1 escalations, recovery retries, attestation
+    failures — or '' when the results carry none of it (older stored
+    results included)."""
+    r = results or {}
+    subs = [r] + [v for v in r.values() if isinstance(v, dict)]
+    chunks = sum(s["chunks"] for s in subs
+                 if isinstance(s.get("chunks"), int))
+    escalated = sum(1 for s in subs
+                    if isinstance(s.get("escalated"), dict))
+    retries = corrupt = 0
+    for s in subs:
+        rec = s.get("recovered")
+        if isinstance(rec, dict):
+            retries += int(rec.get("retries", 0) or 0)
+            corrupt += sum(1 for k in rec.get("faults", [])
+                           if k == "corrupt")
+    if not (chunks or escalated or retries or corrupt):
+        return ""
+    return (f"telemetry: {chunks} device chunks, {escalated} "
+            f"escalated, {retries} recovery retries, {corrupt} "
+            f"attest failures")
+
+
 def service_line(status: dict) -> str:
     """One printable line summarizing a verification service's status
     (the /healthz shape from service.VerificationService.status), or

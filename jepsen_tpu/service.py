@@ -75,9 +75,41 @@ import time as _time
 import traceback
 from typing import Callable
 
-from . import store
+from . import store, telemetry as _telemetry
 
 log = logging.getLogger(__name__)
+
+# -- telemetry (doc/observability.md catalogs these) -------------------------
+_M_EVENTS = _telemetry.counter(
+    "jepsen_tpu_service_stream_events_total",
+    "Stream lifecycle events (admitted / refused / shed / "
+    "quarantined / drained / verdict / resumed)", ("event",))
+_M_ACTIVE = _telemetry.gauge(
+    "jepsen_tpu_service_active_streams",
+    "Streams currently admitted and not yet terminal")
+_M_QUEUE = _telemetry.histogram(
+    "jepsen_tpu_service_queue_rows",
+    "Per-stream op-queue depth, observed at each pump",
+    buckets=(0, 16, 64, 256, 1024, 4096, 16384, 65536))
+_M_OPS = _telemetry.counter(
+    "jepsen_tpu_service_ops_total",
+    "Journal ops fed into stream workers")
+_M_BUDGET_CAP = _telemetry.gauge(
+    "jepsen_tpu_service_budget_capacity_elementops",
+    "ChunkBudget capacity (halved by OOM backpressure, restored "
+    "gradually)")
+_M_BUDGET_AVAIL = _telemetry.gauge(
+    "jepsen_tpu_service_budget_available_elementops",
+    "ChunkBudget element-ops currently available")
+_M_OOMS = _telemetry.counter(
+    "jepsen_tpu_service_budget_ooms_total",
+    "OOM backpressure events that halved the global budget")
+_M_VERB = _telemetry.histogram(
+    "jepsen_tpu_service_verb_seconds",
+    "Socket verb handling latency", ("verb",))
+
+_KNOWN_VERBS = frozenset(
+    {"op", "attach", "poll", "finish", "status", "metrics", "close"})
 
 # stream lifecycle states (see module docstring)
 ADMITTED = "admitted"
@@ -264,6 +296,8 @@ class ChunkBudget:
         self._avail = float(capacity)
         self._cv = threading.Condition()
         self.ooms = 0
+        _M_BUDGET_CAP.set(self.capacity)
+        _M_BUDGET_AVAIL.set(self._avail)
 
     def acquire(self, cost: float, timeout_s: float | None = None,
                 cancel: Callable[[], bool] | None = None) -> bool:
@@ -281,6 +315,7 @@ class ChunkBudget:
                         return False
                 self._cv.wait(wait)
             self._avail -= min(cost, self.capacity)
+            _M_BUDGET_AVAIL.set(self._avail)
             return True
 
     def release(self, cost: float, clean: bool = True) -> None:
@@ -293,6 +328,8 @@ class ChunkBudget:
                     * (self.initial - self.capacity))
             self._avail = min(self.capacity,
                               self._avail + min(cost, self.capacity))
+            _M_BUDGET_CAP.set(self.capacity)
+            _M_BUDGET_AVAIL.set(self._avail)
             self._cv.notify_all()
 
     def note_oom(self) -> None:
@@ -300,6 +337,9 @@ class ChunkBudget:
             self.ooms += 1
             self.capacity = max(self.initial / 64.0, self.capacity / 2)
             self._avail = min(self._avail, self.capacity)
+            _M_OOMS.inc()
+            _M_BUDGET_CAP.set(self.capacity)
+            _M_BUDGET_AVAIL.set(self._avail)
             self._cv.notify_all()
 
     def status(self) -> dict:
@@ -360,6 +400,8 @@ class StreamWorker:
         self.results: dict = {}
         self.error: str | None = None
         self.done = threading.Event()
+        self._term_lock = threading.Lock()
+        self._terminated = False
         self.violation = False
         self.ops_fed = 0
         self.recoveries = 0
@@ -372,6 +414,17 @@ class StreamWorker:
         self.thread = threading.Thread(
             target=self._run, name=f"jepsen-service-{name}",
             daemon=True)
+
+    def _terminal(self, event: str) -> None:
+        """Mark the worker done, counting the terminal lifecycle event
+        exactly once (the first transition wins; a shed racing a drain
+        across threads still counts a single terminal event)."""
+        with self._term_lock:
+            first, self._terminated = not self._terminated, True
+        if first:
+            _M_EVENTS.labels(event=event).inc()
+            _M_ACTIVE.dec()
+        self.done.set()
 
     # -- worker thread -----------------------------------------------------
 
@@ -390,6 +443,12 @@ class StreamWorker:
     def _release_targets(self) -> None:
         self._final_chunks = self._chunk_status()
         self._final_attest_failures = self._attest_failures()
+        for t in self.targets.values():
+            # shed/drained/quarantined streams never reach finish():
+            # record their root trace spans before dropping them, or
+            # their exported chunk spans orphan in the collector
+            if hasattr(t, "end_trace"):
+                t.end_trace()
         self.targets = {}
         self._dead_targets = set()
 
@@ -436,7 +495,7 @@ class StreamWorker:
                 if item is _CLOSE:
                     self.state = SHED
                     self.shed_reason = "client closed"
-                    self.done.set()
+                    self._terminal("shed")
                     return
                 if item is _SEAL:
                     sealed = True
@@ -449,6 +508,8 @@ class StreamWorker:
                     item = self.q.get_nowait()
                 except _queue.Empty:
                     break
+            if fed:
+                _M_OPS.inc(fed)   # one batched inc per drain burst
             self._pump()
             self._note_violation()
             if sealed and self.q.empty():
@@ -489,6 +550,7 @@ class StreamWorker:
         """Dispatch pending chunks under the global budget — the
         cost-model scheduling point. One chunk per acquire, so other
         streams' acquires interleave between our chunks."""
+        _M_QUEUE.observe(self.q.qsize())
         for name, t in self.targets.items():
             if name in self._dead_targets \
                     or not hasattr(t, "pending_chunks"):
@@ -545,7 +607,7 @@ class StreamWorker:
                 log.warning("service %s: could not flush verdicts to "
                             "%s", self.name, self.store_dir,
                             exc_info=True)
-        self.done.set()
+        self._terminal("verdict")
 
     def _quarantine(self, tb: str) -> None:
         """Unclassified failure: this stream is done, degraded, with
@@ -558,7 +620,7 @@ class StreamWorker:
         self.results["error"] = tb
         log.warning("service %s: quarantined on unclassified error; "
                     "siblings unaffected\n%s", self.name, tb)
-        self.done.set()
+        self._terminal("quarantined")
 
     def _bleed_queue(self) -> None:
         try:
@@ -599,7 +661,7 @@ class StreamWorker:
                 log.warning("service %s: could not persist the resume "
                             "manifest", self.name, exc_info=True)
         self.state = DRAINED
-        self.done.set()
+        self._terminal("drained")
 
     # -- service-side API --------------------------------------------------
 
@@ -634,7 +696,7 @@ class StreamWorker:
                     {"deferred": True, "reason": reason})
             except OSError:
                 pass
-        self.done.set()
+        self._terminal("shed")
 
     def status(self) -> dict:
         st = {
@@ -683,6 +745,7 @@ class VerificationService:
         self.drained = threading.Event()
         self.admitted_total = 0
         self.refused_total = 0
+        self.t0 = _time.monotonic()
         self._lock = threading.Lock()
         self._server: _socket.socket | None = None
         self._server_threads: list[threading.Thread] = []
@@ -699,11 +762,13 @@ class VerificationService:
         with self._lock:
             if self.draining:
                 self.refused_total += 1
+                _M_EVENTS.labels(event="refused").inc()
                 raise AdmissionRefused("service is draining")
             active = sum(1 for w in self.workers.values()
                          if not w.done.is_set())
             if active >= self.max_streams:
                 self.refused_total += 1
+                _M_EVENTS.labels(event="refused").inc()
                 raise AdmissionRefused(
                     f"saturated: {active} active streams "
                     f"(max {self.max_streams})")
@@ -716,6 +781,8 @@ class VerificationService:
                              overrides=overrides)
             self.workers[name] = w
             self.admitted_total += 1
+            _M_EVENTS.labels(event="admitted").inc()
+            _M_ACTIVE.inc()
         w.thread.start()
         log.info("service: admitted stream %r (targets %s)", name,
                  sorted(w.targets))
@@ -804,6 +871,7 @@ class VerificationService:
             }
         w = self.admit(name, man["targets"], store_dir=run_dir,
                        overrides=overrides)
+        _M_EVENTS.labels(event="resumed").inc()
         for target, ck in ck_by_target.items():
             t = w.targets.get(target)
             if t is not None and hasattr(t, "import_checkpoint"):
@@ -938,6 +1006,7 @@ class VerificationService:
         return {
             "state": ("drained" if self.drained.is_set()
                       else "draining" if self.draining else "serving"),
+            "uptime_s": round(_time.monotonic() - self.t0, 3),
             "streams": {n: w.status() for n, w in workers.items()},
             "admitted-total": self.admitted_total,
             "refused-total": self.refused_total,
@@ -946,6 +1015,10 @@ class VerificationService:
             "quarantined": sorted(n for n, w in workers.items()
                                   if w.state == QUARANTINED),
             "budget": self.budget.status(),
+            # the service-layer registry slice: stream lifecycle
+            # counters, budget gauges, queue-depth/verb histograms
+            "telemetry": _telemetry.snapshot(
+                prefix="jepsen_tpu_service_", compact=True),
         }
 
     # -- the socket layer --------------------------------------------------
@@ -1026,51 +1099,72 @@ class VerificationService:
                         continue
                     rid = msg.get("id")
                     typ = msg.get("type")
-                    if typ == "op":
-                        if stream is not None:
-                            self.offer(stream, msg.get("op") or {})
-                    elif typ == "attach":
-                        try:
-                            w = self.admit(
-                                str(msg.get("stream")),
-                                msg.get("targets") or {},
-                                store_dir=msg.get("store-dir"))
-                            stream = w.name
-                            reply({"ok": True, "stream": stream,
-                                   "targets": sorted(w.targets)}, rid)
-                        except (AdmissionRefused, ValueError) as e:
-                            reply({"ok": False, "deferred": True,
-                                   "error": str(e)}, rid)
-                    elif typ == "poll":
-                        w = (self.workers.get(stream)
-                             if stream is not None else None)
-                        reply({"ok": True,
-                               "violation": bool(w and w.violation),
-                               "state": w.state if w else None}, rid)
-                    elif typ == "finish":
-                        if stream is None:
-                            reply({"ok": False,
-                                   "error": "not attached"}, rid)
-                            continue
-                        self.seal(stream)
-                        w = self.workers.get(stream)
-                        timeout = float(msg.get("timeout-s") or 600.0)
-                        r = self.result(stream, timeout)
-                        reply({"ok": True, "results": r,
-                               "state": w.state if w else None}, rid)
-                    elif typ == "status":
-                        reply({"ok": True,
-                               "status": self.status()}, rid)
-                    elif typ == "close":
-                        if stream is not None:
+                    t_verb = _time.monotonic()
+                    try:
+                        if typ == "op":
+                            if stream is not None:
+                                self.offer(stream, msg.get("op") or {})
+                        elif typ == "attach":
+                            try:
+                                w = self.admit(
+                                    str(msg.get("stream")),
+                                    msg.get("targets") or {},
+                                    store_dir=msg.get("store-dir"))
+                                stream = w.name
+                                reply({"ok": True, "stream": stream,
+                                       "targets": sorted(w.targets)},
+                                      rid)
+                            except (AdmissionRefused, ValueError) as e:
+                                reply({"ok": False, "deferred": True,
+                                       "error": str(e)}, rid)
+                        elif typ == "poll":
+                            w = (self.workers.get(stream)
+                                 if stream is not None else None)
+                            reply({"ok": True,
+                                   "violation": bool(w and w.violation),
+                                   "state": w.state if w else None},
+                                  rid)
+                        elif typ == "finish":
+                            if stream is None:
+                                reply({"ok": False,
+                                       "error": "not attached"}, rid)
+                                continue
+                            self.seal(stream)
                             w = self.workers.get(stream)
-                            if w is not None \
-                                    and not w.done.is_set():
-                                w.q.put(_CLOSE)
-                        return
-                    else:
-                        reply({"ok": False,
-                               "error": f"unknown type {typ!r}"}, rid)
+                            timeout = float(msg.get("timeout-s")
+                                            or 600.0)
+                            r = self.result(stream, timeout)
+                            reply({"ok": True, "results": r,
+                                   "state": w.state if w else None},
+                                  rid)
+                        elif typ == "status":
+                            reply({"ok": True,
+                                   "status": self.status()}, rid)
+                        elif typ == "metrics":
+                            # the whole registry (not just the
+                            # service slice): one verb answers what
+                            # /metrics answers over HTTP, for
+                            # deployments without --metrics-port
+                            reply({"ok": True,
+                                   "metrics": _telemetry.snapshot(
+                                       compact=bool(
+                                           msg.get("compact")))}, rid)
+                        elif typ == "close":
+                            if stream is not None:
+                                w = self.workers.get(stream)
+                                if w is not None \
+                                        and not w.done.is_set():
+                                    w.q.put(_CLOSE)
+                            return
+                        else:
+                            reply({"ok": False,
+                                   "error": f"unknown type {typ!r}"},
+                                  rid)
+                    finally:
+                        _M_VERB.labels(
+                            verb=(typ if typ in _KNOWN_VERBS
+                                  else "unknown")).observe(
+                            _time.monotonic() - t_verb)
         except (OSError, ValueError):
             log.info("service: connection dropped%s",
                      f" (stream {stream})" if stream else "")

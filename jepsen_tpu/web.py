@@ -21,9 +21,13 @@ import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import store
+from . import store, telemetry
 
 log = logging.getLogger(__name__)
+
+_M_REQUESTS = telemetry.counter(
+    "jepsen_tpu_web_requests_total",
+    "Results-web requests by route", ("route",))
 
 COLORS = {"ok": "#6DB6FE", "info": "#FFAA26", "fail": "#FEB5DA",
           None: "#eaeaea"}
@@ -176,6 +180,8 @@ def home_page(base: str, params: dict | None = None) -> str:
                          ("incomplete", "incomplete")])
     return (
         "<html><body><h1>Jepsen</h1>"
+        '<p><a href="/metrics">metrics</a> (process telemetry, '
+        "Prometheus text)</p>"
         '<form method="get" action="/">'
         f'<input type="text" name="q" value="{q}" '
         'placeholder="search test names">'
@@ -273,11 +279,21 @@ class Handler(BaseHTTPRequestHandler):
         split = urllib.parse.urlsplit(self.path)
         path = urllib.parse.unquote(split.path)
         if path in ("/", ""):
+            _M_REQUESTS.labels(route="home").inc()
             params = {k: v[0]
                       for k, v in urllib.parse.parse_qs(split.query).items()}
             return self._send(
                 200, home_page(self.base, params).encode())
+        if path == "/metrics":
+            # the process-wide registry snapshot: when analyze/serve
+            # run in this process, its chunk/engine/recovery series
+            # are scrapeable straight off the results UI
+            _M_REQUESTS.labels(route="metrics").inc()
+            return self._send(
+                200, telemetry.prometheus_text().encode(),
+                "text/plain; version=0.0.4")
         if path.startswith("/files"):
+            _M_REQUESTS.labels(route="files").inc()
             rel = path[len("/files"):].strip("/")
             if rel.endswith(".zip"):
                 full = self._resolve(rel[:-len(".zip")])

@@ -1,0 +1,586 @@
+"""The observability substrate (jepsen_tpu/telemetry.py + the trace
+exporter rework): registry semantics, Prometheus exposition, the
+/metrics + /healthz HTTP endpoints against a live verification
+service, chunk-level span threading (one trace id run -> stream ->
+chunk), the async trace flusher, and the profiler hooks' no-op
+contract."""
+
+from __future__ import annotations
+
+import json
+import re
+import socket as _socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import telemetry, trace
+
+CHUNK = 64
+SLOTS = 8
+FRONTIER = 128
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Zero every metric's accumulated values between tests (metric
+    declarations are module-level and survive)."""
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    telemetry.reset()
+    telemetry.set_enabled(True)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_injection():
+    from jepsen_tpu import _platform
+    _platform.reset_fault_injection()
+    yield
+    _platform.reset_fault_injection()
+
+
+# -- registry semantics ------------------------------------------------------
+
+def test_counter_labels_and_idempotent_registration():
+    c = telemetry.counter("jepsen_tpu_run_lint_test_total", "t",
+                          ("kind",))
+    c2 = telemetry.counter("jepsen_tpu_run_lint_test_total", "t",
+                           ("kind",))
+    assert c is c2          # get-or-create, one family per name
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc(5)
+    snap = telemetry.snapshot()["jepsen_tpu_run_lint_test_total"]
+    assert snap == {"kind=a": 3.0, "kind=b": 5.0}
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)
+    with pytest.raises(ValueError):   # type change is a bug
+        telemetry.gauge("jepsen_tpu_run_lint_test_total", "t",
+                        ("kind",))
+    with pytest.raises(ValueError):   # label change is a bug
+        telemetry.counter("jepsen_tpu_run_lint_test_total", "t",
+                          ("other",))
+
+
+def test_gauge_and_unlabeled_passthrough():
+    g = telemetry.gauge("jepsen_tpu_run_lint_gauge_info", "t")
+    g.set(4.5)
+    g.inc()
+    g.dec(2)
+    assert telemetry.snapshot()[
+        "jepsen_tpu_run_lint_gauge_info"][""] == 3.5
+
+
+def test_histogram_buckets_sum_count():
+    h = telemetry.histogram("jepsen_tpu_run_lint_hist_seconds", "t",
+                            buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = telemetry.snapshot()["jepsen_tpu_run_lint_hist_seconds"][""]
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(56.05)
+    assert snap["buckets"] == {"0.1": 1, "1.0": 2, "10.0": 1,
+                               "+Inf": 1}
+
+
+def test_histogram_time_context_manager():
+    h = telemetry.histogram("jepsen_tpu_run_lint_timer_seconds", "t")
+    with h.time():
+        time.sleep(0.01)
+    snap = telemetry.snapshot()["jepsen_tpu_run_lint_timer_seconds"][""]
+    assert snap["count"] == 1
+    assert snap["sum"] >= 0.01
+
+
+def test_concurrent_increments_are_exact():
+    c = telemetry.counter("jepsen_tpu_run_lint_race_total", "t")
+    h = telemetry.histogram("jepsen_tpu_run_lint_race_seconds", "t")
+    n, threads = 5000, 8
+
+    def work():
+        for _ in range(n):
+            c.inc()
+            h.observe(0.001)
+
+    ts = [threading.Thread(target=work) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = telemetry.snapshot()
+    assert snap["jepsen_tpu_run_lint_race_total"][""] == n * threads
+    assert snap["jepsen_tpu_run_lint_race_seconds"][""]["count"] \
+        == n * threads
+
+
+def test_set_enabled_turns_mutations_into_noops():
+    c = telemetry.counter("jepsen_tpu_run_lint_off_total", "t")
+    prev = telemetry.set_enabled(False)
+    try:
+        c.inc(100)
+    finally:
+        telemetry.set_enabled(prev)
+    c.inc(1)
+    assert telemetry.snapshot()["jepsen_tpu_run_lint_off_total"][""] \
+        == 1
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+
+def _assert_prometheus_parseable(text: str) -> dict:
+    """Every non-comment line must be `name{labels} value`; returns
+    {name: [line, ...]} for content assertions."""
+    by_name: dict = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE ")), line
+            continue
+        assert _PROM_LINE.match(line), f"unparseable: {line!r}"
+        by_name.setdefault(line.split("{")[0].split(" ")[0],
+                           []).append(line)
+    return by_name
+
+
+def test_prometheus_text_format():
+    c = telemetry.counter("jepsen_tpu_run_lint_fmt_total", "t",
+                          ("kind",))
+    c.labels(kind='we"ird\nvalue').inc()
+    h = telemetry.histogram("jepsen_tpu_run_lint_fmt_seconds", "t",
+                            buckets=(1.0, 5.0))
+    h.observe(0.5)
+    h.observe(2.0)
+    h.observe(99.0)
+    text = telemetry.prometheus_text()
+    lines = _assert_prometheus_parseable(text)
+    assert "# TYPE jepsen_tpu_run_lint_fmt_total counter" \
+        in text.splitlines()
+    assert "# TYPE jepsen_tpu_run_lint_fmt_seconds histogram" \
+        in text.splitlines()
+    # label escaping round-trips quotes/newlines
+    [counter_line] = lines["jepsen_tpu_run_lint_fmt_total"]
+    assert '\\"' in counter_line and "\\n" in counter_line
+    # histogram buckets are cumulative and +Inf equals the count
+    bkt = lines["jepsen_tpu_run_lint_fmt_seconds_bucket"]
+    assert [ln.rsplit(" ", 1)[1] for ln in bkt] == ["1", "2", "3"]
+    assert lines["jepsen_tpu_run_lint_fmt_seconds_count"][0] \
+        .endswith(" 3")
+    # HELP/TYPE appear for registered metrics even with no series yet
+    telemetry.counter("jepsen_tpu_run_lint_empty_total", "t",
+                      ("kind",))
+    assert "# TYPE jepsen_tpu_run_lint_empty_total counter" \
+        in telemetry.prometheus_text()
+
+
+def test_metric_name_lint_is_clean():
+    import sys
+    from pathlib import Path
+    tools = Path(__file__).resolve().parent.parent / "tools"
+    sys.path.insert(0, str(tools))
+    try:
+        import lint_metrics
+        assert lint_metrics.lint_registry() == []
+    finally:
+        sys.path.remove(str(tools))
+
+
+# -- the instrumented pipeline ----------------------------------------------
+
+def _hist(seed, n=300, corrupt=False):
+    from jepsen_tpu.checker import synth
+    h = synth.register_history(n, concurrency=3, values=5, seed=seed)
+    if corrupt:
+        h = synth.corrupt(h, seed=seed + 1)
+    return h
+
+
+def test_offline_analysis_populates_wgl_metrics():
+    from jepsen_tpu import models
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    a = analysis_tpu(models.cas_register(), _hist(7, n=400),
+                     chunk_entries=64)
+    assert a["valid?"] is True
+    snap = telemetry.snapshot()
+    assert sum(snap["jepsen_tpu_wgl_checked_ops_total"].values()) > 0
+    assert sum(snap["jepsen_tpu_wgl_engine_decisions_total"]
+               .values()) >= 1
+    chunk = snap["jepsen_tpu_wgl_chunk_seconds"]
+    assert any(v["count"] > 0 for v in chunk.values())
+    # attestation is default-on: staged-buffer digests verified
+    assert sum(snap["jepsen_tpu_abft_verifications_total"]
+               .values()) > 0
+
+
+def test_recovery_rung_counter_counts_injected_faults(monkeypatch):
+    from jepsen_tpu import models
+    from jepsen_tpu.checker.wgl import analysis_tpu
+
+    monkeypatch.setenv("JEPSEN_TPU_FAULT_INJECT", "oom@offline:1")
+    a = analysis_tpu(models.cas_register(), _hist(11, n=200))
+    assert a["valid?"] is True
+    assert a["recovered"]["faults"] == ["oom"]
+    snap = telemetry.snapshot()["jepsen_tpu_wgl_recovery_rungs_total"]
+    assert snap.get("kind=oom,site=offline") == 1
+
+
+def test_screen_metrics_and_escalation_reasons():
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import screen
+
+    sc = screen.screen_history(models.cas_register(), _hist(13))
+    assert sc["valid?"] is True
+    esc, why = screen.should_escalate({"screenable": False})
+    assert esc and why == "unscreened-model"
+    esc, why = screen.should_escalate({"suspicion": 2.0})
+    assert esc and why == "suspicion"
+    snap = telemetry.snapshot()
+    assert sum(snap["jepsen_tpu_screen_screened_ops_total"]
+               .values()) >= sc["op-count"]
+    e = snap["jepsen_tpu_screen_escalations_total"]
+    assert e.get("why=unscreened-model") == 1
+    assert e.get("why=suspicion") == 1
+
+
+# -- span threading: run -> stream -> chunk -> recovery-retry ---------------
+
+def test_stream_spans_thread_one_trace_id(tmp_path, monkeypatch):
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import streaming
+
+    trace.tracing(str(tmp_path / "spans.jsonl"))
+    try:
+        monkeypatch.setenv("JEPSEN_TPU_FAULT_INJECT",
+                           "oom@stream-chunk:2")
+        h = _hist(17, n=400, corrupt=True)
+        r = streaming.stream_check(
+            models.cas_register(), h, chunk_entries=CHUNK,
+            slots=SLOTS, frontier=FRONTIER, checkpoint_every=2)
+        assert r["valid?"] is False
+        assert r["recovered"]["faults"] == ["oom"]
+        tid = r["trace-id"]
+        assert tid
+        tr = trace.tracer()
+        chunks = tr.spans("wgl.stream.chunk")
+        assert chunks and all(s["traceID"] == tid for s in chunks)
+        retries = tr.spans("wgl.stream.recovery-retry")
+        assert retries and all(s["traceID"] == tid for s in retries)
+        [stream_span] = tr.spans("wgl.stream")
+        assert stream_span["traceID"] == tid
+        # chunks parent to the stream span — the run->stream->chunk
+        # thread a Jaeger UI renders as one tree
+        assert all(s["parentSpanID"] == stream_span["spanID"]
+                   for s in chunks)
+        # the violation tagged the stream span
+        tags = {t["key"]: t["value"] for t in stream_span["tags"]}
+        assert tags.get("violation") == "true"
+    finally:
+        trace.tracing(None)
+
+
+def test_untraced_stream_has_no_trace_id():
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import streaming
+
+    r = streaming.stream_check(models.cas_register(), _hist(19),
+                               chunk_entries=CHUNK, slots=SLOTS)
+    assert r["valid?"] is True
+    assert "trace-id" not in r
+
+
+# -- the async trace flusher -------------------------------------------------
+
+def _slow_collector():
+    """A TCP listener that accepts but never answers — the shape of a
+    wedged Jaeger collector (connects succeed, responses never come)."""
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    stop = threading.Event()
+    conns = []
+
+    def loop():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                conns.append(c)
+            except OSError:
+                continue
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def teardown():
+        stop.set()
+        t.join(2)
+        for c in conns:
+            c.close()
+        srv.close()
+
+    return srv.getsockname()[1], teardown
+
+
+def test_slow_collector_does_not_stall_span_creation():
+    port, teardown = _slow_collector()
+    tr = trace.Tracer(f"http://127.0.0.1:{port}/api/traces")
+    try:
+        t0 = time.monotonic()
+        for i in range(100):
+            with tr.span(f"hot-{i}"):
+                pass
+        create_s = time.monotonic() - t0
+        # the old exporter paid a synchronous POST (1 s timeout) per
+        # span: 100 spans against this collector took >100 s; the
+        # batched flusher makes creation pure enqueue
+        assert create_s < 1.0, \
+            f"span creation stalled {create_s:.2f}s on a slow collector"
+        assert len(tr.spans()) == 100
+        t0 = time.monotonic()
+        tr.close()
+        assert time.monotonic() - t0 < 5.0, "close() unbounded"
+    finally:
+        teardown()
+
+
+def test_unreachable_collector_and_queue_bound():
+    # nothing listens here: connects fail fast, spans still record
+    tr = trace.Tracer("http://127.0.0.1:9/api/traces")
+    try:
+        for i in range(trace.EXPORT_QUEUE_LIMIT + 50):
+            with tr.span("x"):
+                pass
+        with tr.lock:
+            assert len(tr._q) <= trace.EXPORT_QUEUE_LIMIT
+    finally:
+        tr.close()
+
+
+def test_file_exporter_still_synchronous(tmp_path):
+    p = tmp_path / "t.jsonl"
+    tr = trace.Tracer(str(p))
+    with tr.span("a"):
+        trace_id = tr.context()
+    tr.close()
+    [doc] = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert doc["operationName"] == "a"
+    del trace_id
+
+
+# -- profiler hooks ----------------------------------------------------------
+
+def test_profile_section_is_noop_without_env(monkeypatch):
+    monkeypatch.delenv(telemetry.PROFILE_ENV, raising=False)
+    assert telemetry.profile_dir() is None
+    with telemetry.profile_section("wgl.test.chunk"):
+        pass
+    assert telemetry._profiler_started is False
+
+
+def test_profile_section_starts_trace_with_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.PROFILE_ENV, str(tmp_path))
+    try:
+        with telemetry.profile_section("wgl.test.chunk"):
+            pass
+        started = telemetry._profiler_started
+    finally:
+        telemetry.stop_profiler()
+    assert telemetry._profiler_started is False
+    # best-effort: when jax's profiler is available the trace started
+    # and stop_trace wrote the artifact dir; otherwise the no-op path
+    # ran (still a pass — profiling must never be load-bearing)
+    if started:
+        assert any(tmp_path.iterdir())
+
+
+# -- /metrics + /healthz e2e against a live service -------------------------
+
+def _get(url: str) -> tuple[int, str]:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_metrics_http_e2e_two_fake_etcd_streams(tmp_path, monkeypatch):
+    """The acceptance drive: a service with --metrics-port serving two
+    concurrent fake-etcd runs; /metrics returns Prometheus-parseable
+    text carrying chunk-latency histograms and recovery/escalation/
+    attest counters, /healthz the status() JSON (uptime_s + telemetry
+    sub-map)."""
+    import random
+
+    from fake_etcd import FakeEtcd
+
+    import jepsen_tpu.db
+    import jepsen_tpu.os_
+    from jepsen_tpu import core, generator as gen, models, service
+    from jepsen_tpu.checker import linearizable
+    from jepsen_tpu.suites import etcd
+
+    # one deterministic recovery fault on run 0's stream, so the
+    # recovery-rung counter has a live series to expose
+    monkeypatch.setenv(
+        "JEPSEN_TPU_FAULT_INJECT",
+        "oom@stream-chunk/etcd-metrics-0/now0:1")
+
+    svc = service.VerificationService()
+    addr = svc.serve("127.0.0.1:0")
+    msrv = telemetry.serve_metrics(0, host="127.0.0.1",
+                                   healthz=svc.status)
+    mport = msrv.server_address[1]
+
+    fakes = [FakeEtcd(), FakeEtcd()]
+    for f in fakes:
+        f.port = f.start()
+
+    def make_test(i, fake):
+        rng = random.Random(4200 + i)
+        return {
+            "name": f"etcd-metrics-{i}",
+            "start-time": f"now{i}",
+            "nodes": ["n1", "n2", "n3"],
+            "ssh": {"dummy": True},
+            "db": jepsen_tpu.db.noop,
+            "os": jepsen_tpu.os_.noop,
+            "client": etcd.EtcdClient(),
+            "client-url-fn":
+                lambda node: f"http://127.0.0.1:{fake.port}",
+            "concurrency": 4,
+            "store-dir": str(tmp_path / "store"),
+            "checker": linearizable(models.cas_register()),
+            "service": addr,
+            "online-chunk-entries": CHUNK,
+            "online-checkpoint-every": 2,
+            "generator": gen.clients(gen.limit(150, gen.mix([
+                lambda: {"f": "read"},
+                lambda: {"f": "write",
+                         "value": rng.randint(0, 4)},
+                lambda: {"f": "cas",
+                         "value": [rng.randint(0, 4),
+                                   rng.randint(0, 4)]},
+            ]))),
+        }
+
+    done: dict = {}
+
+    def run_one(i, fake):
+        done[i] = core.run(make_test(i, fake))
+
+    ths = [threading.Thread(target=run_one, args=(i, f))
+           for i, f in enumerate(fakes)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(300)
+    for f in fakes:
+        f.stop()
+    try:
+        assert sorted(done) == [0, 1]
+        for i in (0, 1):
+            assert done[i]["results"]["valid?"] is True, \
+                done[i]["results"]
+
+        code, text = _get(f"http://127.0.0.1:{mport}/metrics")
+        assert code == 200
+        lines = _assert_prometheus_parseable(text)
+        # chunk-latency histograms from the served streams
+        assert any(
+            'site="stream"' in ln and ln.rsplit(" ", 1)[1] != "0"
+            for ln in lines.get("jepsen_tpu_wgl_chunk_seconds_count",
+                                []))
+        # recovery climbed a rung on the faulted stream
+        assert any("kind=\"oom\"" in ln for ln in lines.get(
+            "jepsen_tpu_wgl_recovery_rungs_total", []))
+        # attestation verified staged buffers; escalation counter is
+        # cataloged (HELP/TYPE) even when this run never escalated
+        assert any(ln.rsplit(" ", 1)[1] != "0" for ln in lines.get(
+            "jepsen_tpu_abft_verifications_total", []))
+        assert "jepsen_tpu_screen_escalations_total" in text
+        # two admitted streams reached verdicts
+        assert any(
+            'event="admitted"' in ln and ln.endswith(" 2")
+            for ln in lines.get(
+                "jepsen_tpu_service_stream_events_total", []))
+
+        code, body = _get(f"http://127.0.0.1:{mport}/healthz")
+        assert code == 200
+        st = json.loads(body)
+        assert st["uptime_s"] > 0
+        assert "telemetry" in st
+        assert len(st["streams"]) == 2
+
+        # the socket 'metrics' verb answers the same registry
+        host, _, port = addr.rpartition(":")
+        conn = _socket.create_connection((host, int(port)))
+        rf = conn.makefile("r")
+        conn.sendall((json.dumps({"type": "metrics", "id": 1})
+                      + "\n").encode())
+        m = json.loads(rf.readline())
+        assert m["ok"] is True
+        assert "jepsen_tpu_service_stream_events_total" in m["metrics"]
+        conn.close()
+    finally:
+        msrv.shutdown()
+        svc.stop()
+
+
+def test_service_status_carries_uptime_and_telemetry():
+    from jepsen_tpu import service
+
+    svc = service.VerificationService()
+    st = svc.status()
+    assert st["uptime_s"] >= 0
+    assert isinstance(st["telemetry"], dict)
+
+
+# -- surfacing ---------------------------------------------------------------
+
+def test_report_telemetry_line():
+    from jepsen_tpu import report
+
+    line = report.telemetry_line({
+        "linear": {"chunks": 12,
+                   "recovered": {"faults": ["oom", "corrupt"],
+                                 "retries": 2}},
+        "elle": {"escalated": {"why": "suspicion"}},
+    })
+    assert "12 device chunks" in line
+    assert "1 escalated" in line
+    assert "2 recovery retries" in line
+    assert "1 attest failures" in line
+    # older stored results carry none of it
+    assert report.telemetry_line({"valid?": True}) == ""
+    assert report.telemetry_line({}) == ""
+    assert report.telemetry_line(None) == ""
+
+
+def test_web_metrics_route(tmp_path):
+    from jepsen_tpu import web
+
+    server = web.serve({"host": "127.0.0.1", "port": 0,
+                        "store-dir": str(tmp_path)})
+    port = server.server_address[1]
+    try:
+        code, home = _get(f"http://127.0.0.1:{port}/")
+        assert code == 200 and "/metrics" in home
+        code, text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200
+        _assert_prometheus_parseable(text)
+        assert "jepsen_tpu_web_requests_total" in text
+    finally:
+        server.shutdown()
+
+
+def test_cli_service_has_metrics_port_option():
+    from jepsen_tpu import cli
+
+    longs = [o["long"]
+             for o in cli.service_cmd()["service"]["opt_spec"]]
+    assert "--metrics-port" in longs
