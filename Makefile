@@ -4,24 +4,34 @@
 
 PY ?= python
 
-.PHONY: lint test tier0 tier1 check
+.PHONY: lint staticcheck test tier0 tier1 check
 
+# the full static gate: style/imports + metric naming + device-sync
+# (JTS1xx) + lock discipline (JTS2xx) + retrace hazards (JTS3xx) on
+# one driver, baselined — see doc/static_analysis.md. Subsumes the
+# old tools/lint.py + tools/lint_metrics.py (kept as shims).
 lint:
-	$(PY) tools/lint.py
-	$(PY) tools/lint_metrics.py
+	$(PY) -m tools.staticcheck
 	$(PY) -m compileall -q jepsen_tpu tests tools bench.py __graft_entry__.py
+
+# the AST-only analyzers (no module imports, runs in ~a second) —
+# the tier0 pre-gate slice; `make lint` adds the registry-import
+# metrics pass and compileall on top.
+staticcheck:
+	$(PY) -m tools.staticcheck --only style,device-sync,locks,retrace
 
 test:
 	$(PY) -m pytest tests/ -q
 
-# fast pre-gate: the tier-1 screen + ABFT attestation suites plus the
-# telemetry registry/exposition suite (seconds, no kernel compiles
-# beyond the small fault matrices) — run before the full tier-1 sweep
-# so a broken screen/attestation/observability layer fails in the
-# first minute, not the fortieth. CI runs this first.
-tier0:
+# fast pre-gate: staticcheck plus the tier-1 screen + ABFT attestation
+# suites and the telemetry registry/exposition suite (seconds, no
+# kernel compiles beyond the small fault matrices) — run before the
+# full tier-1 sweep so a broken invariant/observability/structural
+# layer fails in the first minute, not the fortieth. CI runs this
+# first.
+tier0: staticcheck
 	$(PY) -m pytest tests/test_screen.py tests/test_attest.py \
-		tests/test_telemetry.py -q
+		tests/test_telemetry.py tests/test_staticcheck.py -q
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
 # holds the larger shape sweeps, e.g. the pallas dedup parity sweep).

@@ -1175,6 +1175,28 @@ def _last_known_good():
     return None
 
 
+def _staticcheck_summary(env):
+    """The staticcheck findings-count summary for the artifact (the
+    CI gate's `--summary-json` line: files / findings / baselined /
+    suppressed / by_code). AST-only analyzers — no module imports, so
+    it is safe and cheap even against a wedged backend. None when the
+    tool itself fails; the gate lives in `make check`, this is just
+    provenance for the round."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "tools.staticcheck",
+             "--only", "style,device-sync,locks,retrace",
+             "--summary-json"],
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+            capture_output=True, text=True, timeout=120)
+        out = json.loads(p.stdout.strip().splitlines()[-1])
+        out["ok"] = p.returncode == 0
+        return out
+    except Exception as e:  # noqa: BLE001 — meta must not sink the run
+        _note(f"staticcheck summary unavailable: {e}")
+        return None
+
+
 def main() -> int:
     ok, backend = preflight_backend()
     degraded = not ok
@@ -1213,6 +1235,9 @@ def main() -> int:
         lkg = _last_known_good()
         if lkg is not None:
             extra["last_known_good_tpu_run"] = lkg
+    sc = _staticcheck_summary(env)
+    if sc is not None:
+        extra["staticcheck"] = sc
     configs = {}
     sections_meta = {}
     headline = None

@@ -301,7 +301,8 @@ def _classify_batches(buckets: dict, mesh=None) -> dict:
     import jax.numpy as jnp
 
     from ..._platform import (CorruptDeviceResult, attest_enabled,
-                              classify_backend_error, maybe_corrupt,
+                              classify_backend_error,
+                              guarded_device_get, maybe_corrupt,
                               maybe_inject_fault)
     from .. import abft
 
@@ -322,7 +323,10 @@ def _classify_batches(buckets: dict, mesh=None) -> dict:
                     nd = mesh.devices.size
                     pad = (-b) % nd
                     if pad:
-                        canon = [np.concatenate(
+                        # inputs arrive bucket-padded (analyze_edges);
+                        # this only rounds the batch up to the mesh
+                        # axis, a second bounded set
+                        canon = [np.concatenate(  # noqa: JTS304
                             [a, np.zeros((pad, e, e), np.float32)])
                             for a in canon]
                 # corrupt AFTER padding so the canonical (padded)
@@ -345,18 +349,23 @@ def _classify_batches(buckets: dict, mesh=None) -> dict:
                     for xj, host in zip(args, canon):
                         abft.verify_steps(
                             "elle",
-                            jax.device_get(abft.digest_device(xj)),
+                            guarded_device_get(
+                                abft.digest_device(xj),
+                                site="elle attest"),
                             abft.digest_host(host))
-                f0, f1, fs, f2, res = fn(*args)
+                # one guarded fetch for the whole verdict tuple: the
+                # sync watchdog covers it, and a wedged backend
+                # classifies into the retry below instead of hanging
+                f0, f1, fs, f2, res = guarded_device_get(
+                    fn(*args), site="elle classify")
                 if attest_on:
-                    bad = np.asarray(res)[:b]
+                    bad = res[:b]
                     if bad.any():
                         raise CorruptDeviceResult(
                             "elle", f"closure column-checksum residue "
                                     f"{bad.max()} != 0 on {int((bad != 0).sum())} "
                                     f"SCC block(s)")
-                out[e] = tuple(np.asarray(x)[:b]
-                               for x in (f0, f1, fs, f2))
+                out[e] = tuple(x[:b] for x in (f0, f1, fs, f2))
                 break
             except RuntimeError as exc:
                 kind = classify_backend_error(exc)
@@ -627,10 +636,16 @@ def analyze_edges(n: int, edges: dict, mesh=None,
     buckets: dict[int, tuple] = {}
     for e, labs in by_bucket.items():
         b = len(labs)
-        ww = np.zeros((b, e, e), np.float32)
-        wr = np.zeros((b, e, e), np.float32)
-        rw = np.zeros((b, e, e), np.float32)
-        aux = [np.zeros((b, e, e), np.float32) for _ in levels[1:]]
+        # bucket the batch axis like the SCC size: the classifier
+        # kernel is jitted per (B, e, e) shape, so an exact B would
+        # recompile the triple closure for every distinct SCC count —
+        # pad with zero blocks (no edges -> no anomaly flags), sliced
+        # off by the bp-strided read below
+        bp = _bucket(b, lo=1)
+        ww = np.zeros((bp, e, e), np.float32)
+        wr = np.zeros((bp, e, e), np.float32)
+        rw = np.zeros((bp, e, e), np.float32)
+        aux = [np.zeros((bp, e, e), np.float32) for _ in levels[1:]]
         slot = {lab: ix for ix, lab in enumerate(labs)}
         mask = np.isin(e_lab, labs)
         for i, j, t, lab in zip(e_src[mask], e_dst[mask], e_t[mask],
@@ -656,11 +671,11 @@ def analyze_edges(n: int, edges: dict, mesh=None,
         flags = _classify_batches(buckets, mesh=mesh)
         for e, (f0, f1, fs, f2) in flags.items():
             labs = by_bucket[e]
-            b = len(labs)
+            bp = _bucket(len(labs), lo=1)
             for ix, lab in enumerate(labs):
                 per_level = []
                 for li in range(n_levels):
-                    o = li * b + ix
+                    o = li * bp + ix
                     per_level.append((
                         bool(f0[o]), bool(f1[o]), bool(fs[o]),
                         bool(f2[o]) and g2_verified(lab, li)))
@@ -718,7 +733,8 @@ def transitive_closure(adj: np.ndarray, mesh=None) -> np.ndarray:
         from jax.sharding import NamedSharding, PartitionSpec as P
         axis = mesh.axis_names[0]
         x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
-    return np.asarray(fn(x))[:n, :n]
+    from ..._platform import guarded_device_get
+    return guarded_device_get(fn(x), site="elle closure")[:n, :n]
 
 
 # ---------------------------------------------------------------------------

@@ -2196,6 +2196,13 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
     if items:
         E = _bucket(max(max(event_count(ops) for _, ops, _ in items), 1))
         padded = [st.pad_to(E) for _, _, st in items]
+        # bucket the batch axis like E: the vmapped kernels are jitted
+        # per (B, E) shape, so an exact B would recompile the whole
+        # family for every distinct key count — pad with zero-step
+        # entries (n=0: never consumed, frontier stays at the initial
+        # config), skipped by the per-item j < len(items) reads below
+        padded += [Steps.empty(padded[0].w, E)] * (
+            _bucket(len(padded), lo=1) - len(padded))
         if dense is not None:
             k = _dense_kernel(name, dense[0], dense[1], dense[2], E,
                               pallas=pallas)
@@ -2234,8 +2241,11 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
                     x, jnp.asarray(np.minimum(ns, stop)), carry)
                 prev, carry = carry, nxt
                 e = stop
+                # pad entries never consume, so their frontiers stay
+                # alive forever — only the real items' liveness counts
                 all_dead = not np.asarray(guarded_device_get(
-                    prev[-2], site="batch liveness")).any()
+                    prev[-2],
+                    site="batch liveness"))[:len(items)].any()
             chunk_obs.observe(_time.monotonic() - t_chunk)
             if all_dead:
                 carry = prev   # every frontier died: all definite
@@ -2254,19 +2264,24 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
                 abft.verify_carry(
                     "batch", np.asarray(hd)[bi],
                     tuple(np.asarray(a)[bi] for a in hc))
-        ok, death, overflow, max_count, att = guarded_device_get(
-            jax.vmap(k.summarize)(carry), site="batch summarize")
+        # ONE guarded fetch for the verdicts AND the carry components
+        # the decided-mask below needs: the consumed/count buffers were
+        # previously pulled via raw np.asarray — an unguarded implicit
+        # sync (JTS103) and a second device round-trip
+        (ok, death, overflow, max_count, att), consumed, counts = \
+            guarded_device_get(
+                (jax.vmap(k.summarize)(carry), carry[0], carry[-2]),
+                site="batch summarize")
         _check_att(np.asarray(att).sum(), "batch")
         _M_OPS.labels(site="batch").inc(
             sum(len(o) for _, o, _ in items))
-        counts = np.asarray(carry[-2])
         batch_dedup = (DEDUP_NONE if dense is not None else
                        dedup_engine(frontier, slots,
                                     _pack_params(srange, slots),
                                     pallas))
         # a key is decided if it consumed all entries or its frontier
         # died (death is definitive no matter how many entries remain)
-        decided = (np.asarray(carry[0]) >= ns) | (counts == 0)
+        decided = (np.asarray(consumed) >= ns) | (counts == 0)
         suspects = []    # overflow + invalid: escalate together
         invalids = []    # definite invalid: blame together
         for j, (i, ops, st) in enumerate(items):
@@ -2290,6 +2305,8 @@ def _analysis_tpu_batch_once(model, hists: list, frontier: int = 1024,
             # unmerged streams fit E by construction)
             st2s = [build_steps(ops, slots, merge=False).pad_to(E)
                     for _, _, ops in invalids]
+            st2s += [Steps.empty(st2s[0].w, E)] * (
+                _bucket(len(st2s), lo=1) - len(st2s))
             okb, deathb, *_ = guarded_device_get(k.check_batch(
                 jnp.asarray(np.stack([s.x for s in st2s])),
                 jnp.asarray(np.asarray([s.n for s in st2s], np.int32)),

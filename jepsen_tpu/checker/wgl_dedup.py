@@ -94,8 +94,14 @@ def compiles() -> bool:
         try:
             import numpy as np
 
+            from .._platform import guarded_device_get
+
             fn = dedup_fn(8, 4, interpret=False)
-            out, _new, cnt, _dig = fn(np.arange(8, dtype=np.int32))
+            # guarded: a wedged relay at probe time must downgrade to
+            # the sort path (via the except below), not hang the first
+            # checker call of the process forever
+            out, _new, cnt, _dig = guarded_device_get(
+                fn(np.arange(8, dtype=np.int32)), site="dedup probe")
             _PROBE = int(cnt) == 8 and list(map(int, out)) == [0, 1, 2, 3]
         except Exception:   # Mosaic lowering/compile failure
             _PROBE = False

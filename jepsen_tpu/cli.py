@@ -560,9 +560,63 @@ def service_cmd() -> dict:
     }}
 
 
+def staticcheck_cmd() -> dict:
+    """`jepsen-tpu staticcheck` — the repo's static-analysis gate
+    (tools/staticcheck, doc/static_analysis.md) as a CLI subcommand.
+    A thin forwarder to `python -m tools.staticcheck`: same flags,
+    same exit codes (0 clean/baselined, 1 with findings). Only
+    available from a source checkout — the analyzers check the tree,
+    so there is nothing to run against an installed package."""
+    def run_staticcheck(options):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        if not os.path.isdir(os.path.join(repo, "tools",
+                                          "staticcheck")):
+            print("staticcheck: tools/staticcheck not found next to "
+                  "the jepsen_tpu package (requires a source "
+                  "checkout)", file=sys.stderr)
+            raise SystemExit(254)
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tools.staticcheck.driver import main as sc_main
+
+        argv = list(options.get("targets") or [])
+        if options.get("only"):
+            argv += ["--only", options["only"]]
+        if options.get("baseline"):
+            argv += ["--baseline", options["baseline"]]
+        if options.get("write_baseline"):
+            argv.append("--write-baseline")
+        if options.get("summary_json"):
+            argv.append("--summary-json")
+        raise SystemExit(sc_main(argv))
+
+    return {"staticcheck": {
+        "opt_spec": [
+            opt("targets", nargs="*", metavar="TARGET",
+                help="Files/dirs to check (default: the whole tree)"),
+            opt("--only", metavar="ANALYZERS",
+                help="Comma-separated analyzer subset (style, "
+                     "metrics, device-sync, locks, retrace)"),
+            opt("--baseline", metavar="PATH",
+                help="Baseline file (default: "
+                     "tools/staticcheck/baseline.txt)"),
+            opt("--write-baseline", action="store_true",
+                help="Rewrite the baseline from current findings"),
+            opt("--summary-json", action="store_true",
+                help="Emit one machine-readable JSON summary line"),
+        ],
+        "usage": "Runs the static-analysis gate "
+                 "(doc/static_analysis.md)",
+        "run": run_staticcheck,
+    }}
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     logging.basicConfig(level=logging.INFO)
-    run({**serve_cmd(), **service_cmd()}, argv)
+    run({**serve_cmd(), **service_cmd(), **staticcheck_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -292,10 +292,10 @@ class ChunkBudget:
 
     def __init__(self, capacity: float = DEFAULT_BUDGET_ELEMENTOPS):
         self.initial = float(capacity)
-        self.capacity = float(capacity)
-        self._avail = float(capacity)
+        self.capacity = float(capacity)     # guarded-by: _cv
+        self._avail = float(capacity)       # guarded-by: _cv
         self._cv = threading.Condition()
-        self.ooms = 0
+        self.ooms = 0                       # guarded-by: _cv
         _M_BUDGET_CAP.set(self.capacity)
         _M_BUDGET_AVAIL.set(self._avail)
 
@@ -401,7 +401,7 @@ class StreamWorker:
         self.error: str | None = None
         self.done = threading.Event()
         self._term_lock = threading.Lock()
-        self._terminated = False
+        self._terminated = False        # guarded-by: _term_lock
         self.violation = False
         self.ops_fed = 0
         self.recoveries = 0
@@ -736,23 +736,25 @@ class VerificationService:
         self.queue_ops = queue_ops
         self.shed_timeout_s = shed_timeout_s
         self.budget = ChunkBudget(budget_elementops)
-        self.workers: dict[str, StreamWorker] = {}
+        self.workers: dict[str, StreamWorker] = {}  # guarded-by: _lock
         # finished workers kept (newest last) for late status/result
         # queries; older ones are reaped so a long-lived daemon's
         # worker table stays bounded
         self.keep_done = 64
-        self.draining = False
+        self.draining = False           # guarded-by: _lock
         self.drained = threading.Event()
-        self.admitted_total = 0
-        self.refused_total = 0
+        self.admitted_total = 0         # guarded-by: _lock
+        self.refused_total = 0          # guarded-by: _lock
         self.t0 = _time.monotonic()
         self._lock = threading.Lock()
         self._server: _socket.socket | None = None
         self._server_threads: list[threading.Thread] = []
         self._watch_stop = threading.Event()
         self._watcher: threading.Thread | None = None
-        self._tails: dict[str, tuple] = {}   # run_dir -> (tail, name)
-        self._finished_dirs: set[str] = set()
+        # run_dir -> (tail, name); shared by resume()/watch() callers
+        # and the watcher thread
+        self._tails: dict[str, tuple] = {}      # guarded-by: _lock
+        self._finished_dirs: set[str] = set()   # guarded-by: _lock
 
     # -- admission ---------------------------------------------------------
 
@@ -788,26 +790,35 @@ class VerificationService:
                  sorted(w.targets))
         return w
 
-    def _reap_done_locked(self) -> None:
+    def _reap_done_locked(self) -> None:  # holds: _lock
         done = [n for n, w in self.workers.items() if w.done.is_set()]
         for n in done[:-self.keep_done] if self.keep_done else done:
             del self.workers[n]
 
+    def _worker(self, name: str | None) -> StreamWorker | None:
+        """Locked worker lookup — the JTS2xx discipline: every read of
+        the shared worker table goes through the service lock (admit's
+        insert and _reap_done_locked's deletes race it otherwise)."""
+        if name is None:
+            return None
+        with self._lock:
+            return self.workers.get(name)
+
     def offer(self, name: str, op: dict) -> bool:
-        w = self.workers.get(name)
+        w = self._worker(name)
         if w is None:
             return False
         return w.offer(op, self.shed_timeout_s)
 
     def seal(self, name: str) -> None:
-        w = self.workers.get(name)
+        w = self._worker(name)
         if w is not None:
             w.seal()
 
     def result(self, name: str, timeout_s: float | None = 600.0) -> dict:
         """Block until the stream's verdicts are in; {} for a stream
         that was shed/drained (offline covers those)."""
-        w = self.workers.get(name)
+        w = self._worker(name)
         if w is None:
             return {}
         if not w.done.wait(timeout_s):
@@ -815,7 +826,7 @@ class VerificationService:
         return dict(w.results)
 
     def shed(self, name: str, reason: str = "operator") -> None:
-        w = self.workers.get(name)
+        w = self._worker(name)
         if w is not None:
             w.shed(reason)
 
@@ -825,19 +836,25 @@ class VerificationService:
         """Stop admissions, checkpoint every live stream's carry, and
         persist per-run resume manifests — the SIGTERM path."""
         with self._lock:
-            if self.draining:
-                self.drained.wait(timeout_s)
-                return
-            self.draining = True
+            already = self.draining
+            if not already:
+                self.draining = True
+                workers = list(self.workers.values())
+        if already:
+            # wait for the first drainer OUTSIDE the lock: every
+            # service verb (offer/seal/poll/finish/status) now takes
+            # _lock for its worker lookup, so blocking here with the
+            # lock held would freeze the whole service for timeout_s
+            self.drained.wait(timeout_s)
+            return
         log.info("service: draining %d streams",
-                 sum(1 for w in self.workers.values()
-                     if not w.done.is_set()))
+                 sum(1 for w in workers if not w.done.is_set()))
         self._watch_stop.set()
-        for w in list(self.workers.values()):
+        for w in workers:
             if not w.done.is_set():
                 w._drain.set()
         deadline = _time.monotonic() + timeout_s
-        for w in list(self.workers.values()):
+        for w in workers:
             w.done.wait(max(0.0, deadline - _time.monotonic()))
         self.drained.set()
         log.info("service: drained")
@@ -912,7 +929,8 @@ class VerificationService:
 
     def _tail_run(self, run_dir: str, name: str) -> None:
         jp = os.path.join(run_dir, "journal.jsonl")
-        self._tails[run_dir] = (store.JournalTail(jp), name)
+        with self._lock:
+            self._tails[run_dir] = (store.JournalTail(jp), name)
         self._ensure_watcher()
 
     def _scan(self) -> None:
@@ -922,7 +940,10 @@ class VerificationService:
             return
         for tname, runs in store.tests(base).items():
             for start, d in runs.items():
-                if d in self._tails or d in self._finished_dirs:
+                with self._lock:
+                    known = (d in self._tails
+                             or d in self._finished_dirs)
+                if known:
                     continue
                 if not os.path.exists(
                         os.path.join(d, "journal.jsonl")):
@@ -934,7 +955,8 @@ class VerificationService:
                     # a service (this one or a predecessor) already
                     # delivered/deferred this run: re-admitting would
                     # re-verify the whole history on every scan
-                    self._finished_dirs.add(d)
+                    with self._lock:
+                        self._finished_dirs.add(d)
                     continue
                 if store.load_service_resume(d) is not None:
                     try:
@@ -966,11 +988,14 @@ class VerificationService:
                                 exc_info=True)
                 last_scan = now
             sleep = 0.25
-            for d, (tail, name) in list(self._tails.items()):
-                w = self.workers.get(name)
+            with self._lock:
+                tails = list(self._tails.items())
+            for d, (tail, name) in tails:
+                w = self._worker(name)
                 if w is None or w.done.is_set():
-                    self._tails.pop(d, None)
-                    self._finished_dirs.add(d)
+                    with self._lock:
+                        self._tails.pop(d, None)
+                        self._finished_dirs.add(d)
                     continue
                 if tail.idle_s > 0 and now < getattr(
                         tail, "_next_poll", 0.0):
@@ -980,16 +1005,18 @@ class VerificationService:
                     ops = tail.poll()
                 except ValueError:
                     w._quarantine(traceback.format_exc())
-                    self._tails.pop(d, None)
+                    with self._lock:
+                        self._tails.pop(d, None)
                     continue
                 for op in ops:
-                    self.offer(name, op)
+                    w.offer(op, self.shed_timeout_s)
                 if not ops and os.path.exists(
                         os.path.join(d, "history.jsonl.gz")):
                     # the run saved its history: the journal is
                     # complete and fully fed — seal for the verdict
-                    self.seal(name)
-                    self._tails.pop(d, None)
+                    w.seal()
+                    with self._lock:
+                        self._tails.pop(d, None)
                     continue
                 # decorrelated-jitter idle backoff (satellite): quiet
                 # journals get polled less and less, any data resets
@@ -1003,13 +1030,15 @@ class VerificationService:
         """The /healthz shape."""
         with self._lock:
             workers = dict(self.workers)
+            draining = self.draining
+            admitted, refused = self.admitted_total, self.refused_total
         return {
             "state": ("drained" if self.drained.is_set()
-                      else "draining" if self.draining else "serving"),
+                      else "draining" if draining else "serving"),
             "uptime_s": round(_time.monotonic() - self.t0, 3),
             "streams": {n: w.status() for n, w in workers.items()},
-            "admitted-total": self.admitted_total,
-            "refused-total": self.refused_total,
+            "admitted-total": admitted,
+            "refused-total": refused,
             "shed": sorted(n for n, w in workers.items()
                            if w.state == SHED),
             "quarantined": sorted(n for n, w in workers.items()
@@ -1118,8 +1147,7 @@ class VerificationService:
                                 reply({"ok": False, "deferred": True,
                                        "error": str(e)}, rid)
                         elif typ == "poll":
-                            w = (self.workers.get(stream)
-                                 if stream is not None else None)
+                            w = self._worker(stream)
                             reply({"ok": True,
                                    "violation": bool(w and w.violation),
                                    "state": w.state if w else None},
@@ -1130,7 +1158,7 @@ class VerificationService:
                                        "error": "not attached"}, rid)
                                 continue
                             self.seal(stream)
-                            w = self.workers.get(stream)
+                            w = self._worker(stream)
                             timeout = float(msg.get("timeout-s")
                                             or 600.0)
                             r = self.result(stream, timeout)
@@ -1151,7 +1179,7 @@ class VerificationService:
                                            msg.get("compact")))}, rid)
                         elif typ == "close":
                             if stream is not None:
-                                w = self.workers.get(stream)
+                                w = self._worker(stream)
                                 if w is not None \
                                         and not w.done.is_set():
                                     w.q.put(_CLOSE)
@@ -1199,10 +1227,10 @@ class ServiceClient:
         self._sock = _connect(addr)
         self._rf = self._sock.makefile("r", encoding="utf-8")
         self._wlock = threading.Lock()
-        self._rid = 0
-        self._replies: dict[int, dict] = {}
+        self._rid = 0                       # guarded-by: _reply_evt
+        self._replies: dict[int, dict] = {}  # guarded-by: _reply_evt
         self._reply_evt = threading.Condition()
-        self._closed = False
+        self._closed = False                # guarded-by: _reply_evt
         self._last_poll = 0.0
         self._reader = threading.Thread(
             target=self._read_loop, name="jepsen-service-client",
@@ -1272,7 +1300,9 @@ class ServiceClient:
     # -- OnlineChecker surface ---------------------------------------------
 
     def offer(self, op: dict) -> None:
-        if self._closed:
+        # lock-free read by design: _closed is a monotonic flag, and
+        # the op hot path must not take the reply lock per op
+        if self._closed:  # noqa: JTS201
             return
         try:
             self._send({"type": "op", "op": op})
@@ -1286,7 +1316,8 @@ class ServiceClient:
     def should_abort(self) -> bool:
         if self.aborted:
             return True
-        if not self.abort_on_violation or self._closed:
+        # monotonic-flag fast path (see offer)
+        if not self.abort_on_violation or self._closed:  # noqa: JTS201
             return False
         now = _time.monotonic()
         if now - self._last_poll < POLL_INTERVAL_S:
@@ -1301,7 +1332,7 @@ class ServiceClient:
         """Seal the stream and collect its verdicts — shaped exactly
         like OnlineChecker.finalize (deferred/drained streams return
         {}, so offline checking covers them)."""
-        if self._closed:
+        if self._closed:  # noqa: JTS201 — monotonic-flag fast path
             return {}
         r = self._request({"type": "finish",
                            "timeout-s": timeout_s},

@@ -134,12 +134,12 @@ class Journal:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.flush_interval_s = flush_interval_s
-        self._fh = open(path, "a", buffering=64 * 1024)
+        self._fh = open(path, "a", buffering=64 * 1024)  # guarded-by: _io
         self._buf: _collections.deque = _collections.deque()
         self._io = _threading.Lock()
         self._closed = False
         self._wake = _threading.Event()
-        self._subs: list = []
+        self._subs: list = []           # guarded-by: _sub_lock
         # guards subscriber notification: unsubscribe() takes it too,
         # so unsubscription is SYNCHRONOUS — once it returns, no
         # callback can still be in flight (an async remove raced the
@@ -195,7 +195,7 @@ class Journal:
         with self._io:
             self._drain_locked()
 
-    def _drain_locked(self) -> None:
+    def _drain_locked(self) -> None:  # holds: _io
         if self._fh is None:
             return
         try:

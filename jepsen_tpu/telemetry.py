@@ -19,8 +19,9 @@ visible only as log lines. This module is the observability substrate:
     text format, served by :func:`serve_metrics` at ``/metrics`` and
     by the results web UI). ``/healthz`` serves the JSON the caller
     provides (the service's ``status()`` shape).
-  * **Naming convention** (linted by ``tools/lint_metrics.py`` in
-    ``make check``): ``jepsen_tpu_<layer>_<name>_<unit>`` with layer
+  * **Naming convention** (linted by ``tools/staticcheck``'s metrics
+    analyzer in ``make check``):
+    ``jepsen_tpu_<layer>_<name>_<unit>`` with layer
     in :data:`LAYERS` and unit in :data:`UNITS`; counters end in
     ``_total``.
   * **Profiler hooks.** ``JEPSEN_TPU_PROFILE=<dir>`` makes
@@ -45,9 +46,9 @@ import threading
 import time
 from typing import Callable, Iterable
 
-# metric-name vocabulary (tools/lint_metrics.py enforces this over
-# every registered metric; keep the sets in sync with the doc catalog
-# in doc/observability.md)
+# metric-name vocabulary (tools/staticcheck's metrics analyzer
+# enforces this over every registered metric; keep the sets in sync
+# with the doc catalog in doc/observability.md)
 LAYERS = ("wgl", "streaming", "screen", "abft", "service", "trace",
           "run", "web")
 UNITS = ("total", "seconds", "rows", "ops", "chunks", "elementops",
@@ -93,7 +94,7 @@ class _Child:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.value = 0.0
+        self.value = 0.0        # guarded-by: _lock
 
 
 class _CounterChild(_Child):
@@ -129,9 +130,9 @@ class _HistogramChild:
     def __init__(self, buckets: tuple):
         self._lock = threading.Lock()
         self.buckets = buckets          # upper bounds, ascending
-        self.counts = [0] * (len(buckets) + 1)   # +1 for +Inf
-        self.sum = 0.0
-        self.count = 0
+        self.counts = [0] * (len(buckets) + 1)   # guarded-by: _lock
+        self.sum = 0.0                  # guarded-by: _lock
+        self.count = 0                  # guarded-by: _lock
 
     def observe(self, value: float) -> None:
         if not _enabled:
@@ -169,7 +170,7 @@ class Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._children: dict[tuple, object] = {}
+        self._children: dict[tuple, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         if not self.labelnames:
             self._children[()] = self._make_child()
@@ -179,7 +180,10 @@ class Metric:
 
     def labels(self, **kw):
         key = _label_values(self.labelnames, kw)
-        child = self._children.get(key)
+        # lock-free fast path by design: _children is insert-only and
+        # dict reads are atomic under the GIL — the hot path must not
+        # pay the registry lock per increment
+        child = self._children.get(key)  # noqa: JTS201
         if child is None:
             with self._lock:
                 child = self._children.setdefault(key,
@@ -202,7 +206,9 @@ class Metric:
     def _solo(self):
         if self.labelnames:
             raise ValueError(f"{self.name} needs labels(...)")
-        return self._children[()]
+        # lock-free by design: the () child is created in __init__ and
+        # never replaced except by clear() (test-only)
+        return self._children[()]  # noqa: JTS201
 
 
 class Counter(Metric):
@@ -255,7 +261,7 @@ class Registry:
     may build private ones."""
 
     def __init__(self):
-        self._metrics: dict[str, Metric] = {}
+        self._metrics: dict[str, Metric] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
 
     def register(self, cls, name: str, help: str,  # noqa: A002
@@ -494,7 +500,7 @@ def serve_metrics(port: int, host: str = "127.0.0.1",
 # ---------------------------------------------------------------------------
 
 _profiler_lock = threading.Lock()
-_profiler_started = False
+_profiler_started = False       # guarded-by: _profiler_lock
 
 
 def profile_dir() -> str | None:
@@ -509,7 +515,7 @@ def _ensure_profiler() -> bool:
     d = profile_dir()
     if not d:
         return False
-    if _profiler_started:
+    if _profiler_started:  # noqa: JTS201 — double-checked fast path
         return True
     with _profiler_lock:
         if _profiler_started:

@@ -112,12 +112,12 @@ class Tracer:
                  buffer_limit: int = 100_000):
         self.endpoint = endpoint
         self.enabled = endpoint is not None
-        self.buffer: list[dict] = []
+        self.buffer: list[dict] = []    # guarded-by: lock
         self.buffer_limit = buffer_limit
         self.lock = threading.Lock()
-        self._file = None
+        self._file = None               # guarded-by: lock
         self._http = False
-        self._q: collections.deque = collections.deque()
+        self._q: collections.deque = collections.deque()  # guarded-by: lock
         self._q_event = threading.Event()
         self._stop = threading.Event()
         self._flusher: threading.Thread | None = None

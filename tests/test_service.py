@@ -444,6 +444,27 @@ def test_service_admission_control():
         svc.admit("late", {"linear": _wgl_spec()})
 
 
+def test_concurrent_drain_does_not_hold_service_lock():
+    """A second drain() must wait for the first OUTSIDE _lock: every
+    service verb's worker lookup takes _lock, so waiting under it
+    would freeze offer/poll/status (incl. /healthz) for timeout_s."""
+    svc = service.VerificationService()
+    with svc._lock:
+        svc.draining = True     # simulate a first drainer in flight
+    t = threading.Thread(target=svc.drain, kwargs={"timeout_s": 2.0},
+                         daemon=True)
+    t.start()
+    time.sleep(0.1)             # let it reach the wait
+    t0 = time.monotonic()
+    st = svc.status()
+    took = time.monotonic() - t0
+    assert st["state"] == "draining"
+    assert took < 0.5, f"status() blocked {took:.2f}s behind drain()"
+    svc.drained.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
 # -- drain + resume ---------------------------------------------------------
 
 @pytest.mark.parametrize("seed,corrupt", [(73, False), (74, True)])

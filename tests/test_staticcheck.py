@@ -1,0 +1,659 @@
+"""tools/staticcheck — the repo-specific static-analysis suite.
+
+Fixture snippets per analyzer (positive AND negative per JTS code),
+suppression + baseline handling, lock-order inversion, and the
+self-check that the live jepsen_tpu/ tree is clean modulo the
+committed baseline. Tier-0: pure AST work, no kernels."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tools.staticcheck.base import SourceFile  # noqa: E402
+from tools.staticcheck.devicesync import DeviceSyncAnalyzer  # noqa: E402
+from tools.staticcheck.driver import (default_baseline, run,  # noqa: E402
+                                      write_baseline)
+from tools.staticcheck.lockcheck import LockAnalyzer  # noqa: E402
+from tools.staticcheck.retrace import RetraceAnalyzer  # noqa: E402
+from tools.staticcheck.style import StyleAnalyzer  # noqa: E402
+
+CHECKER_REL = "jepsen_tpu/checker/fixture.py"
+
+
+def codes(analyzer, rel, snippet):
+    sf = SourceFile.from_text(rel, textwrap.dedent(snippet))
+    assert analyzer.scope(sf), f"{rel} must be in {analyzer.name} scope"
+    return [f.code for f in analyzer.check_file(sf)]
+
+
+def findings(analyzer, rel, snippet):
+    sf = SourceFile.from_text(rel, textwrap.dedent(snippet))
+    return analyzer.check_file(sf)
+
+
+# ---------------------------------------------------------------------------
+# style (JTS00x)
+# ---------------------------------------------------------------------------
+
+def test_style_unused_and_duplicate_imports():
+    got = codes(StyleAnalyzer(), "mod.py", """\
+        import os
+        import json
+        import json
+        print(json.dumps({}))
+        """)
+    assert got.count("JTS002") == 1   # os unused
+    assert got.count("JTS003") == 1   # json twice
+
+
+def test_style_string_annotation_names_count_as_used():
+    # the old tools/lint.py false-positive class: typing-only names
+    # referenced only from quoted annotations forced # noqa noise
+    got = codes(StyleAnalyzer(), "mod.py", """\
+        from typing import Optional, Sequence
+        from collections import OrderedDict
+
+        def f(x: "Optional[int]") -> "Sequence[OrderedDict]":
+            return [x]
+        """)
+    assert "JTS002" not in got
+
+
+def test_style_nested_forward_ref_in_real_annotation():
+    got = codes(StyleAnalyzer(), "mod.py", """\
+        from typing import Optional
+        from collections import OrderedDict
+
+        def f(x: Optional["OrderedDict"]) -> None:
+            del x
+        """)
+    assert "JTS002" not in got
+
+
+def test_style_whitespace_and_length():
+    src = ("x = 1 \n"            # trailing whitespace
+           "if x:\n"
+           "\ty = 2\n"           # tab indent
+           "z = '" + "a" * 120 + "'\n")
+    got = [f.code for f in StyleAnalyzer().check_file(
+        SourceFile.from_text("mod.py", src))]
+    assert {"JTS004", "JTS005", "JTS006"} <= set(got)
+
+
+def test_style_syntax_error():
+    got = codes(StyleAnalyzer(), "mod.py", "def f(:\n")
+    assert got == ["JTS001"]
+
+
+# ---------------------------------------------------------------------------
+# device-sync (JTS10x)
+# ---------------------------------------------------------------------------
+
+def test_jts101_raw_device_get():
+    got = codes(DeviceSyncAnalyzer(), CHECKER_REL, """\
+        import jax
+
+        def f(k, x):
+            return jax.device_get(k.check(x))
+        """)
+    assert "JTS101" in got
+
+
+def test_jts101_guarded_is_clean():
+    got = codes(DeviceSyncAnalyzer(), CHECKER_REL, """\
+        from .._platform import guarded_device_get
+
+        def f(k, x):
+            return guarded_device_get(k.check(x), site="t")
+        """)
+    assert got == []
+
+
+def test_jts102_block_until_ready():
+    got = codes(DeviceSyncAnalyzer(), CHECKER_REL, """\
+        def f(y):
+            return y.block_until_ready()
+        """)
+    assert got == ["JTS102"]
+
+
+def test_jts103_asarray_over_entry_result():
+    got = codes(DeviceSyncAnalyzer(), CHECKER_REL, """\
+        import numpy as np
+
+        def f(k, x):
+            carry = k.check_stream_chunk(x)
+            return np.asarray(carry[0])
+        """)
+    assert got == ["JTS103"]
+
+
+def test_jts103_int_over_factory_callable_result():
+    got = codes(DeviceSyncAnalyzer(), CHECKER_REL, """\
+        def f(x):
+            fn = _kernel("m", 1, 2, 3)
+            out, cnt = fn(x)
+            return int(cnt)
+        """)
+    assert got == ["JTS103"]
+
+
+def test_jts103_guarded_fetch_then_host_math_is_clean():
+    got = codes(DeviceSyncAnalyzer(), CHECKER_REL, """\
+        import numpy as np
+        from .._platform import guarded_device_get
+
+        def f(k, x):
+            carry = k.check_chunk(x)
+            host = guarded_device_get(carry, site="t")
+            return int(np.asarray(host[0]).sum())
+        """)
+    assert got == []
+
+
+def test_devicesync_scope_is_checker_and_service():
+    az = DeviceSyncAnalyzer()
+    assert az.scope(SourceFile.from_text(CHECKER_REL, ""))
+    assert az.scope(SourceFile.from_text("jepsen_tpu/service.py", ""))
+    assert not az.scope(SourceFile.from_text("jepsen_tpu/core.py", ""))
+    assert not az.scope(SourceFile.from_text("bench.py", ""))
+
+
+# ---------------------------------------------------------------------------
+# locks (JTS20x)
+# ---------------------------------------------------------------------------
+
+LOCK_MOD = """\
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0          # guarded-by: _lock
+
+        def good(self):
+            with self._lock:
+                self.n += 1
+
+        def bad(self):
+            return self.n
+
+        def held(self):  # holds: _lock
+            return self.n
+    """
+
+
+def test_jts201_unguarded_access_and_exemptions():
+    got = findings(LockAnalyzer(), "mod.py", LOCK_MOD)
+    # one finding, in bad() — good()/held()/__init__ are exempt
+    assert [f.code for f in got] == ["JTS201"]
+    assert got[0].line == 13
+
+
+def test_jts201_module_global():
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        _glock = threading.Lock()
+        _state = 0   # guarded-by: _glock
+
+        def bad():
+            return _state
+
+        def good():
+            global _state
+            with _glock:
+                _state += 1
+        """)
+    assert got == ["JTS201"]
+
+
+def test_jts201_module_global_in_guarded_class_reported_once():
+    # telemetry.py's shape: module-level guarded globals AND a guarded
+    # class; an unguarded module-global access inside a method of the
+    # guarded class must yield ONE finding, not one per walk
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        GLOCK = threading.Lock()
+        G = 0   # guarded-by: GLOCK
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0   # guarded-by: _lock
+
+            def bad(self):
+                global G
+                G = 1
+        """)
+    assert got == ["JTS201"]
+
+
+def test_jts202_lock_order_inversion():
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+                self.x = 0   # guarded-by: a
+                self.y = 0   # guarded-by: b
+
+            def p(self):
+                with self.a:
+                    with self.b:
+                        self.x, self.y = 1, 1
+
+            def q(self):
+                with self.b:
+                    with self.a:
+                        self.x, self.y = 2, 2
+        """)
+    assert got.count("JTS202") == 1
+
+
+def test_jts202_consistent_order_is_clean():
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+                self.x = 0   # guarded-by: a
+
+            def p(self):
+                with self.a:
+                    with self.b:
+                        self.x = 1
+
+            def q(self):
+                with self.a:
+                    with self.b:
+                        self.x = 2
+        """)
+    assert "JTS202" not in got
+
+
+def test_jts203_unknown_lock():
+    got = codes(LockAnalyzer(), "mod.py", """\
+        class S:
+            def __init__(self):
+                self.n = 0   # guarded-by: _lock
+        """)
+    assert got == ["JTS203"]
+
+
+def test_jts201_with_item_access_is_checked():
+    # `with self._fh:` is an access to _fh, not a lock acquisition
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self._io = threading.Lock()
+                self._fh = open("x")   # guarded-by: _io
+
+            def bad(self):
+                with self._fh:
+                    pass
+
+            def good(self):
+                with self._io:
+                    with self._fh:
+                        pass
+        """)
+    assert got == ["JTS201"]
+
+
+def test_jts201_nested_function_reported_once():
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        _glock = threading.Lock()
+        _g = 0   # guarded-by: _glock
+
+        def outer():
+            def inner():
+                return _g
+            return inner
+        """)
+    assert got == ["JTS201"]
+
+
+def test_locks_inherited_annotation():
+    got = codes(LockAnalyzer(), "mod.py", """\
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = 0.0   # guarded-by: _lock
+
+        class Child(Base):
+            def bad(self):
+                return self.value
+
+            def good(self):
+                with self._lock:
+                    return self.value
+        """)
+    assert got == ["JTS201"]
+
+
+# ---------------------------------------------------------------------------
+# retrace (JTS30x)
+# ---------------------------------------------------------------------------
+
+def test_jts301_jit_closure_over_mutable_global():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import jax
+
+        _MODE = 0
+
+        def set_mode(m):
+            global _MODE
+            _MODE = m
+
+        @jax.jit
+        def f(x):
+            return x + _MODE
+        """)
+    assert got == ["JTS301"]
+
+
+def test_jts301_single_assignment_constant_is_clean():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import jax
+        import jax.numpy as jnp
+
+        SCALE = 3
+
+        @jax.jit
+        def f(x):
+            return x * jnp.int32(SCALE)
+        """)
+    assert got == []
+
+
+def test_jts302_python_branch_on_traced_value():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """)
+    assert got == ["JTS302"]
+
+
+def test_jts302_static_properties_are_clean():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if x.dtype == jnp.uint32 and len(x.shape) > 1:
+                return x.sum()
+            return x
+        """)
+    assert got == []
+
+
+def test_jts303_bare_scalar_at_kernel_entry():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        def f(k, x, sl):
+            return k.check_stream_chunk(x, len(sl), 0)
+        """)
+    assert got.count("JTS303") == 2
+
+
+def test_jts303_wrapped_scalar_is_clean():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import jax.numpy as jnp
+
+        def f(k, x, sl):
+            return k.check_stream_chunk(x, jnp.int32(len(sl)), x)
+        """)
+    assert got == []
+
+
+def test_jts303_nested_function_reported_once():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        def outer(k, x):
+            def inner():
+                return k.check(x, 5, x)
+            return inner
+        """)
+    assert got == ["JTS303"]
+
+
+def test_jts304_unbucketed_batch_stack():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(k, items, s):
+            x = jnp.asarray(np.stack([i.x for i in items]))
+            return k.check_batch(x, s, s)
+        """)
+    assert got == ["JTS304"]
+
+
+def test_jts304_bucket_padded_stack_is_clean():
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(k, items, s, E):
+            padded = [i.pad_to(E) for i in items]
+            padded += [Z] * (_bucket(len(padded), lo=1) - len(padded))
+            x = jnp.asarray(np.stack([i.x for i in padded]))
+            return k.check_batch(x, s, s)
+        """)
+    assert got == []
+
+
+def test_jts304_sliced_stack_does_not_chain():
+    # a sliced/re-chunked result no longer carries the stack's
+    # dynamic length — the streaming recovery-replay shape
+    got = codes(RetraceAnalyzer(), CHECKER_REL, """\
+        import numpy as np
+
+        def f(k, parts, need, s):
+            tail = np.concatenate(parts)[-need:]
+            carry = k.init_carry(s)
+            return helper(tail, carry)
+        """)
+    assert got == []
+
+
+# ---------------------------------------------------------------------------
+# suppression, baseline, driver semantics
+# ---------------------------------------------------------------------------
+
+def _fixture_repo(tmp_path: Path, body: str) -> Path:
+    d = tmp_path / "repo" / "jepsen_tpu" / "checker"
+    d.mkdir(parents=True)
+    (tmp_path / "repo" / "jepsen_tpu" / "__init__.py").write_text("")
+    (d / "__init__.py").write_text("")
+    (d / "mod.py").write_text(textwrap.dedent(body))
+    return tmp_path / "repo"
+
+
+BAD_SYNC = """\
+    import jax
+
+    def f(k, x):
+        return jax.device_get(k.check(x))
+    """
+
+
+def test_driver_reports_seeded_violation(tmp_path):
+    repo = _fixture_repo(tmp_path, BAD_SYNC)
+    res = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+              baseline_path=tmp_path / "baseline.txt")
+    assert res["findings"] == 1
+    assert res["by_code"] == {"JTS101": 1}
+
+
+def test_noqa_specific_code_suppresses(tmp_path):
+    repo = _fixture_repo(tmp_path, """\
+        import jax
+
+        def f(k, x):
+            return jax.device_get(k.check(x))  # noqa: JTS101 — why
+        """)
+    res = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+              baseline_path=tmp_path / "baseline.txt")
+    assert res["findings"] == 0 and res["suppressed"] == 1
+
+
+def test_noqa_wrong_code_does_not_suppress(tmp_path):
+    repo = _fixture_repo(tmp_path, """\
+        import jax
+
+        def f(k, x):
+            return jax.device_get(k.check(x))  # noqa: JTS999
+        """)
+    res = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+              baseline_path=tmp_path / "baseline.txt")
+    assert res["findings"] == 1
+
+
+def test_bare_noqa_suppresses(tmp_path):
+    repo = _fixture_repo(tmp_path, """\
+        import jax
+
+        def f(k, x):
+            return jax.device_get(k.check(x))  # noqa
+        """)
+    res = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+              baseline_path=tmp_path / "baseline.txt")
+    assert res["findings"] == 0 and res["suppressed"] == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    repo = _fixture_repo(tmp_path, BAD_SYNC)
+    bl = tmp_path / "baseline.txt"
+    res = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+              baseline_path=bl)
+    assert res["findings"] == 1
+    write_baseline(bl, res["_all"])
+    res2 = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+               baseline_path=bl)
+    assert res2["findings"] == 0 and res2["baselined"] == 1
+    # baseline entries carry no line numbers: adding a leading line
+    # (shifting the finding) still matches
+    mod = repo / "jepsen_tpu" / "checker" / "mod.py"
+    mod.write_text("# moved\n" + mod.read_text())
+    res3 = run(["jepsen_tpu"], only={"device-sync"}, repo=repo,
+               baseline_path=bl)
+    assert res3["findings"] == 0 and res3["baselined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI + live tree
+# ---------------------------------------------------------------------------
+
+def _cli(args, cwd=ROOT, timeout=240):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.staticcheck", *args], cwd=cwd,
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_seeded_fixture_exits_1(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nimport os\n")
+    p = _cli([str(bad), "--only", "style",
+              "--baseline", str(tmp_path / "b.txt")])
+    assert p.returncode == 1
+    assert "JTS002" in p.stdout and "JTS003" in p.stdout
+    assert ":2: " in p.stdout   # path:line: CODE message shape
+
+
+def test_cli_summary_json(tmp_path):
+    p = _cli(["--only", "style,device-sync,locks,retrace",
+              "--summary-json"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["findings"] == 0
+    assert set(out["analyzers"]) == {"style", "device-sync", "locks",
+                                     "retrace"}
+    assert out["files"] > 100
+
+
+def test_write_baseline_refuses_filtered_run(tmp_path):
+    # a filtered run sees a subset of findings — writing it out would
+    # erase baseline entries for the analyzers/files that did not run
+    b = tmp_path / "b.txt"
+    b.write_text("x.py: JTS201 pre-existing debt\n")
+    for extra in (["--only", "style"], ["tools/staticcheck"]):
+        p = _cli([*extra, "--write-baseline", "--baseline", str(b)])
+        assert p.returncode == 2, p.stdout + p.stderr
+        assert "requires a full run" in p.stderr
+    assert b.read_text() == "x.py: JTS201 pre-existing debt\n"
+
+
+def test_cli_subcommand_forwards_to_driver(tmp_path):
+    """`jepsen-tpu staticcheck` (python -m jepsen_tpu staticcheck) is
+    a thin forwarder to the driver: same flags, same exit codes."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nimport os\n")
+    p = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu", "staticcheck", str(bad),
+         "--only", "style", "--baseline", str(tmp_path / "b.txt")],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "JTS002" in p.stdout and "JTS003" in p.stdout
+    p = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu", "staticcheck",
+         "--only", "locks", "--summary-json"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["analyzers"] == ["locks"] and out["findings"] == 0
+
+
+@pytest.mark.parametrize("shim", ["tools/lint.py",
+                                  "tools/lint_metrics.py"])
+def test_legacy_shims_still_pass(shim):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    p = subprocess.run([sys.executable, shim], cwd=ROOT, env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_live_tree_clean_modulo_baseline():
+    """The self-check: the shipped jepsen_tpu/ tree has no unbaselined
+    findings — the CI gate this PR installs."""
+    res = run([], only={"style", "device-sync", "locks", "retrace"})
+    live = [f.render() for f in res["_live"]]
+    assert live == [], "\n".join(live)
+
+
+def test_committed_baseline_matches_format():
+    text = default_baseline().read_text()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        assert ": JTS" in line, line

@@ -1,141 +1,26 @@
 #!/usr/bin/env python
-"""In-repo lint gate (the reference gates every push on `lein eastwood`,
-`.travis.yml:1-11`; no third-party linter is available in this image,
-so the checks that matter are implemented here directly).
+"""Back-compat shim: the in-repo lint gate now lives in
+tools/staticcheck (the style analyzer, JTS00x — syntax, unused /
+duplicate imports, whitespace, line length). This entry point keeps
+the historical CLI: ``python tools/lint.py [targets...]``, one
+``path:line: ...`` per finding, exit 1 when dirty.
 
-Checks, per Python file:
-
-  * syntax (ast.parse)
-  * unused imports — an imported name never referenced in the module
-    (`# noqa` on the import line exempts deliberate re-exports)
-  * duplicate imports of the same name
-  * tabs in indentation, trailing whitespace
-  * lines longer than MAX_LINE columns
-
-Exit 0 when clean; prints one `path:line: message` per finding
-otherwise and exits 1.
-"""
+Prefer ``python -m tools.staticcheck`` (or ``make lint``), which runs
+the whole suite: style + metric naming + device-sync + lock
+discipline + retrace hazards. See doc/static_analysis.md."""
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-MAX_LINE = 100
-ROOTS = ["jepsen_tpu", "tests", "tools", "bench.py", "__graft_entry__.py"]
-
-
-def _imported_names(tree: ast.AST):
-    """Yield (lineno, bound-name, is-future, is-toplevel) for every
-    import binding.  Function-local imports are idiomatic in this
-    codebase (they defer jax init), so duplicate detection only looks
-    at the is-toplevel subset."""
-    toplevel = set()
-    for node in ast.iter_child_nodes(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            toplevel.add(id(node))
-    for node in ast.walk(tree):
-        top = id(node) in toplevel
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                bound = a.asname or a.name.split(".")[0]
-                # dedup on the full dotted path: `import urllib.error`
-                # and `import urllib.request` both bind `urllib` but
-                # are distinct imports
-                yield node.lineno, bound, a.asname or a.name, False, top
-        elif isinstance(node, ast.ImportFrom):
-            future = node.module == "__future__"
-            prefix = f"{node.module}." if node.module else ""
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                bound = a.asname or a.name
-                yield (node.lineno, bound, prefix + a.name, future, top)
-
-
-def _used_names(tree: ast.AST) -> set[str]:
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-    # names exported via __all__ count as used
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Assign)
-                and any(isinstance(t, ast.Name) and t.id == "__all__"
-                        for t in node.targets)):
-            for c in ast.walk(node.value):
-                if isinstance(c, ast.Constant) and isinstance(c.value, str):
-                    used.add(c.value)
-    return used
-
-
-def lint_file(path: Path) -> list[str]:
-    problems: list[str] = []
-    text = path.read_text()
-    lines = text.splitlines()
-
-    try:
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-
-    noqa = {i + 1 for i, line in enumerate(lines) if "# noqa" in line}
-
-    used = _used_names(tree)
-    seen: dict[str, int] = {}
-    for lineno, name, dotted, future, top in _imported_names(tree):
-        if lineno in noqa or future:
-            continue
-        if top:
-            key = f"{dotted} as {name}"
-            if key in seen and seen[key] != lineno:
-                problems.append(
-                    f"{path}:{lineno}: duplicate import of {dotted!r} "
-                    f"(first at line {seen[key]})")
-            seen.setdefault(key, lineno)
-        if name not in used and not name.startswith("_"):
-            problems.append(f"{path}:{lineno}: unused import {name!r}")
-
-    for i, line in enumerate(lines, 1):
-        if i in noqa:
-            continue
-        if line != line.rstrip():
-            problems.append(f"{path}:{i}: trailing whitespace")
-        body = line[:len(line) - len(line.lstrip())]
-        if "\t" in body:
-            problems.append(f"{path}:{i}: tab in indentation")
-        if len(line) > MAX_LINE:
-            problems.append(
-                f"{path}:{i}: line too long ({len(line)} > {MAX_LINE})")
-    return problems
-
-
-def main(argv: list[str]) -> int:
-    repo = Path(__file__).resolve().parent.parent
-    targets = argv or ROOTS
-    files: list[Path] = []
-    for t in targets:
-        p = repo / t
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    problems: list[str] = []
-    for f in files:
-        problems.extend(lint_file(f))
-    for msg in problems:
-        print(msg)
-    print(f"lint: {len(files)} files, {len(problems)} problem(s)",
-          file=sys.stderr)
-    return 1 if problems else 0
-
-
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1:]))
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.staticcheck.driver import run
+
+    res = run(sys.argv[1:], only={"style"})
+    for f in res["_live"]:
+        print(f.render())
+    print(f"lint: {res['files']} files, {res['findings']} problem(s)",
+          file=sys.stderr)
+    sys.exit(1 if res["findings"] else 0)

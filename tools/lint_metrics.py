@@ -1,91 +1,31 @@
 #!/usr/bin/env python
-"""Metric-name lint: one naming pass over the live registry.
+"""Back-compat shim: the metric-name lint now lives in
+tools/staticcheck (the metrics analyzer, JTS01x) — one naming pass
+over the live registry against the ``jepsen_tpu_<layer>_<name>_<unit>``
+convention from doc/observability.md. This entry point keeps the
+historical CLI and output (``name: message`` lines, exit 1 when
+dirty).
 
-Imports every instrumented module (which registers its metrics at
-import time) and asserts the ``jepsen_tpu_<layer>_<name>_<unit>``
-convention from doc/observability.md over each registered metric:
-
-  * prefix ``jepsen_tpu_``, layer in telemetry.LAYERS, final token
-    (the unit) in telemetry.UNITS, all-lowercase snake_case;
-  * counters end in ``_total``; nothing else may;
-  * histograms end in a measurable unit (``_seconds``, ``_rows``,
-    ``_bytes``, ``_ops``, ``_elementops``) — the Prometheus
-    ``_bucket``/``_sum``/``_count`` suffixes hang off that base.
-
-Run by ``make check`` (the reference gates pushes on lint,
-`.travis.yml:1-11`); exit 0 when clean, 1 with one `name: message`
-line per finding otherwise.
-"""
+Prefer ``python -m tools.staticcheck`` (or ``make lint``), which runs
+the whole suite. See doc/static_analysis.md."""
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-
-HISTOGRAM_UNITS = ("seconds", "rows", "bytes", "ops", "elementops")
-
-# the instrumented modules — importing them registers their metrics
-MODULES = (
-    "jepsen_tpu.telemetry",
-    "jepsen_tpu.trace",
-    "jepsen_tpu.checker.wgl",
-    "jepsen_tpu.checker.streaming",
-    "jepsen_tpu.checker.screen",
-    "jepsen_tpu.checker.abft",
-    "jepsen_tpu.service",
-    "jepsen_tpu.web",
-)
-
-
-def lint_registry() -> list[str]:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    # runnable as `python tools/lint_metrics.py` from the repo root
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo not in sys.path:
-        sys.path.insert(0, repo)
-    import importlib
-    for m in MODULES:
-        importlib.import_module(m)
-    from jepsen_tpu import telemetry
-
-    pat = re.compile(
-        r"^jepsen_tpu_(%s)_[a-z0-9_]+_(%s)$"
-        % ("|".join(telemetry.LAYERS), "|".join(telemetry.UNITS)))
-    problems: list[str] = []
-    metrics = telemetry.REGISTRY.metrics()
-    if not metrics:
-        return ["registry is empty — instrumented modules did not "
-                "register their metrics at import time"]
-    for m in metrics:
-        if not pat.match(m.name):
-            problems.append(
-                f"{m.name}: does not match "
-                f"jepsen_tpu_<layer>_<name>_<unit> "
-                f"(layers {telemetry.LAYERS}, units "
-                f"{telemetry.UNITS})")
-            continue
-        if m.kind == "counter" and not m.name.endswith("_total"):
-            problems.append(f"{m.name}: counters must end in _total")
-        if m.kind != "counter" and m.name.endswith("_total"):
-            problems.append(
-                f"{m.name}: _total is reserved for counters "
-                f"({m.kind})")
-        if m.kind == "histogram" and \
-                not m.name.endswith(HISTOGRAM_UNITS):
-            problems.append(
-                f"{m.name}: histograms must end in a measurable "
-                f"unit {HISTOGRAM_UNITS}")
-    return problems
 
 
 def main() -> int:
-    problems = lint_registry()
-    for p in problems:
-        print(p)
-    from jepsen_tpu import telemetry
-    print(f"lint-metrics: {len(telemetry.REGISTRY.names())} metrics, "
-          f"{len(problems)} problem(s)", file=sys.stderr)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.staticcheck.metrics import lint_registry
+
+    problems, n = lint_registry(repo)
+    for _code, name, msg in problems:
+        print(f"{name}: {msg}")
+    print(f"lint-metrics: {n} metrics, {len(problems)} problem(s)",
+          file=sys.stderr)
     return 1 if problems else 0
 
 

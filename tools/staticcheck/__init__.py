@@ -1,0 +1,19 @@
+"""`jepsen-tpu staticcheck` — the repo's whole-program static-analysis
+suite (doc/static_analysis.md).
+
+Five analyzers on one driver, mirroring the reference's `lein
+eastwood` CI gate (`.travis.yml:1-11`) but specialised to what this
+codebase's correctness actually hinges on:
+
+  style        JTS00x  syntax / imports / whitespace (ex tools/lint.py)
+  metrics      JTS01x  metric naming (ex tools/lint_metrics.py)
+  device-sync  JTS10x  every device fetch rides guarded_device_get
+  locks        JTS20x  `# guarded-by:` / `# holds:` lock discipline
+  retrace      JTS30x  stable jit trace signatures
+
+Run: ``python -m tools.staticcheck`` (or ``make lint`` /
+``make staticcheck``). Suppress: ``# noqa: JTS###``. Pre-existing
+debt: ``tools/staticcheck/baseline.txt``."""
+
+from .base import Analyzer, Finding, SourceFile  # noqa: F401 — public API
+from .driver import main, run  # noqa: F401 — public API

@@ -1,0 +1,160 @@
+"""Shared plumbing for the repo's static-analysis suite.
+
+A *finding* is one `path:line: CODE message` diagnostic. An *analyzer*
+is a named pass producing findings, either per-file (handed the parsed
+AST, shared across analyzers so each file is read and parsed once) or
+whole-program (the metrics-registry pass). The driver
+(`tools/staticcheck/driver.py`) owns file collection, suppression, the
+committed baseline, and exit-code semantics.
+
+Suppression grammar (doc/static_analysis.md):
+
+  * ``# noqa: JTS123`` on the offending line suppresses that code
+    there (comma-separated lists allowed; anything after an ``em``
+    dash or the code list is free-text rationale).
+  * A bare ``# noqa`` suppresses *every* code on the line.
+  * Analyzers migrated from the old tools/lint.py keep its looser
+    legacy rule — any ``# noqa`` mention exempts the line — so
+    pre-existing ``# noqa: F401``-style exemptions keep working.
+
+Baseline: `tools/staticcheck/baseline.txt` holds pre-existing debt as
+``path: CODE message`` lines (no line numbers, so unrelated edits
+don't churn it). Findings matching a baseline entry don't fail the
+gate; regenerate with ``--write-baseline`` after deliberate changes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+NOQA_RE = re.compile(r"#\s*noqa(?!\w)(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                     re.IGNORECASE)
+CODE_RE = re.compile(r"[A-Z]+[0-9]+")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative, forward slashes
+    line: int
+    code: str          # e.g. "JTS101"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.path}: {self.code} {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed target, shared by every per-file analyzer."""
+
+    path: Path              # absolute
+    rel: str                # repo-relative, forward slashes
+    text: str
+    lines: list[str] = field(default_factory=list)
+    tree: ast.AST | None = None
+    syntax_error: SyntaxError | None = None
+
+    @classmethod
+    def load(cls, path: Path, repo: Path) -> "SourceFile":
+        text = path.read_text()
+        try:
+            rel = path.relative_to(repo).as_posix()
+        except ValueError:      # explicit target outside the repo
+            rel = path.as_posix()
+        return cls.from_text(rel, text, path=path)
+
+    @classmethod
+    def from_text(cls, rel: str, text: str,
+                  path: Path | None = None) -> "SourceFile":
+        """Build from source text directly (test fixtures)."""
+        sf = cls(path=path or Path(rel), rel=rel, text=text,
+                 lines=text.splitlines())
+        try:
+            sf.tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            sf.syntax_error = e
+        return sf
+
+    def noqa_codes(self, line: int) -> set[str] | None:
+        """Codes suppressed on `line`: a set of codes, the sentinel
+        {"*"} for a bare noqa, or None when the line has no noqa."""
+        if not (1 <= line <= len(self.lines)):
+            return None
+        m = NOQA_RE.search(self.lines[line - 1])
+        if m is None:
+            return None
+        codes = m.group("codes")
+        if not codes:
+            return {"*"}
+        found = set(CODE_RE.findall(codes.upper()))
+        return found or {"*"}
+
+    def suppressed(self, finding: Finding, legacy: bool = False) -> bool:
+        codes = self.noqa_codes(finding.line)
+        if codes is None:
+            return False
+        if legacy:      # old tools/lint.py rule: any noqa exempts
+            return True
+        return "*" in codes or finding.code in codes
+
+
+class Analyzer:
+    """Base class. Per-file analyzers override check_file; whole-
+    program analyzers override check_program (called once, after the
+    per-file sweep, with every collected SourceFile)."""
+
+    name = "base"
+    codes: tuple[str, ...] = ()
+    #: legacy=True keeps the old tools/lint.py bare-noqa semantics
+    legacy_noqa = False
+
+    def scope(self, sf: SourceFile) -> bool:
+        """Is this file in the analyzer's scope?"""
+        return sf.rel.endswith(".py")
+
+    def check_file(self, sf: SourceFile) -> list[Finding]:
+        return []
+
+    def check_program(self, files: list[SourceFile]) -> list[Finding]:
+        return []
+
+
+# -- small AST helpers shared by the analyzers --------------------------------
+
+def call_root(node: ast.AST) -> str | None:
+    """The leftmost Name of a (possibly dotted) callee expression:
+    `jax.device_get` -> 'jax', `np.asarray` -> 'np', `foo(...)` ->
+    'foo'. None for anything fancier."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def attr_name(call: ast.Call) -> str | None:
+    """The attribute name of an attribute call (`k.check(...)` ->
+    'check'), or the bare Name (`fn(...)` -> 'fn')."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def contains_call_to(node: ast.AST, names: set[str]) -> bool:
+    for c in ast.walk(node):
+        if isinstance(c, ast.Call) and attr_name(c) in names:
+            return True
+    return False

@@ -1,0 +1,211 @@
+"""Device-sync analyzer (JTS10x): every blocking device fetch in the
+checking pipeline must ride ``_platform.guarded_device_get``.
+
+Why: `guarded_device_get` is where the JEPSEN_TPU_SYNC_DEADLINE_S
+watchdog and the fault classifier live — a raw `jax.device_get`, a
+`.block_until_ready()`, or an implicit sync (`np.asarray` /
+`int()`/`bool()`/`float()` over a device value) bypasses both, so a
+wedged TPU hangs the calling stream forever instead of raising
+`WedgedDeviceSync` and climbing the recovery ladder.
+
+Scope: ``jepsen_tpu/checker/`` and ``jepsen_tpu/service.py`` (the
+long-lived daemon paths; `_platform.py` itself hosts the wrapper).
+
+  JTS101  raw jax.device_get call
+  JTS102  .block_until_ready() call
+  JTS103  implicit sync: np.asarray/np.array or int/float/bool over a
+          device-value expression
+
+Device values are tracked *function-locally*: results of known jitted
+kernel entries (``k.check`` / ``check_stream_chunk`` / ``summarize``
+/ ...), of callables bound from kernel factories (``fn =
+_flags_batch_fn(...)``), of `jnp.*` / `jax.device_put` / `jax.vmap`
+calls — propagated through assignments, tuple unpacking, subscripts,
+and comprehension targets. `guarded_device_get(...)` launders taint
+(its result is host data). Attribute state (``self._carry``) and
+cross-function flows are out of scope — keep device values local to
+the dispatch function, which every current call site does."""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Analyzer, Finding, SourceFile, attr_name, call_root
+
+# jitted kernel-entry attribute names (the Kernel namedtuple surface
+# plus the abft digest entries)
+ENTRY_NAMES = {
+    "check", "check_batch", "check_chunk", "check_chunk_batch",
+    "check_stream_chunk", "init_carry", "summarize", "digest",
+    "digest_device",
+}
+
+# factories whose return value is a jitted callable (calling it yields
+# a device value)
+FACTORY_NAMES = {
+    "_kernel", "_dense_kernel", "_kernel_cached",
+    "_dense_kernel_cached", "_flags_batch_fn", "_closure_fn",
+    "dedup_fn", "_mk_digest", "_sharded_runner",
+}
+
+GUARD_NAMES = {"guarded_device_get"}
+SYNC_BUILTINS = {"int", "float", "bool"}
+NP_ROOTS = {"np", "numpy"}
+
+
+class _FunctionTaint(ast.NodeVisitor):
+    """One pass over a function body: track device-tainted local
+    names, flag unguarded syncs."""
+
+    def __init__(self, sf: SourceFile, findings: list[Finding]):
+        self.sf = sf
+        self.findings = findings
+        self.tainted: set[str] = set()
+        self.jit_callables: set[str] = set()
+
+    # -- taint predicates ---------------------------------------------------
+
+    def _is_device_call(self, call: ast.Call) -> bool:
+        name = attr_name(call)
+        if name in GUARD_NAMES:
+            return False
+        root = call_root(call.func)
+        if root in GUARD_NAMES:
+            return False
+        if isinstance(call.func, ast.Attribute) and name in ENTRY_NAMES:
+            return True
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in self.jit_callables:
+            return True
+        if root == "jnp":
+            return True
+        if root == "jax" and name in {"device_put", "device_get"}:
+            return True
+        # jax.vmap(...)(args), jax.jit(...)(args)
+        if isinstance(call.func, ast.Call):
+            inner = call.func
+            if call_root(inner.func) == "jax" \
+                    and attr_name(inner) in {"vmap", "pmap", "jit"}:
+                return True
+        return False
+
+    def _tainted_expr(self, node: ast.AST) -> bool:
+        """Does this expression evaluate to (or contain) a device
+        value? Calls are boundaries: a device call taints, any other
+        call is *opaque* — its result is not assumed device-typed
+        just because a device value went in (guarded_device_get and
+        host helpers would otherwise poison everything downstream)."""
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            return self._is_device_call(node)
+        return any(self._tainted_expr(c)
+                   for c in ast.iter_child_nodes(node))
+
+    def _taint_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._taint_target(el)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+
+    def _untaint_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._untaint_target(el)
+
+    def _is_guard_call(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and (attr_name(node) in GUARD_NAMES))
+
+    # -- visitors -----------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        v = node.value
+        if isinstance(v, ast.Call) and attr_name(v) in FACTORY_NAMES:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.jit_callables.add(t.id)
+            return
+        if self._is_guard_call(v):
+            for t in node.targets:
+                self._untaint_target(t)
+            return
+        if self._tainted_expr(v):
+            for t in node.targets:
+                self._taint_target(t)
+        else:
+            for t in node.targets:
+                self._untaint_target(t)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._tainted_expr(node.iter):
+            self._taint_target(node.target)
+        self.generic_visit(node)
+
+    def visit_comprehension_targets(self, node) -> None:
+        for gen in node.generators:
+            if self._tainted_expr(gen.iter):
+                self._taint_target(gen.target)
+
+    def visit_ListComp(self, node) -> None:
+        self.visit_comprehension_targets(node)
+        self.generic_visit(node)
+
+    visit_SetComp = visit_GeneratorExp = visit_ListComp
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        name = attr_name(node)
+        root = call_root(node.func)
+        if name == "device_get" and root != "_platform":
+            self.findings.append(Finding(
+                self.sf.rel, node.lineno, "JTS101",
+                "raw jax.device_get bypasses the sync watchdog and "
+                "fault classifier; route through "
+                "_platform.guarded_device_get"))
+            return
+        if name == "block_until_ready":
+            self.findings.append(Finding(
+                self.sf.rel, node.lineno, "JTS102",
+                ".block_until_ready() is an unguarded blocking sync; "
+                "route through _platform.guarded_device_get"))
+            return
+        implicit = (root in NP_ROOTS and name in {"asarray", "array"}) \
+            or (isinstance(node.func, ast.Name)
+                and node.func.id in SYNC_BUILTINS)
+        if implicit and any(self._tainted_expr(a) for a in node.args):
+            self.findings.append(Finding(
+                self.sf.rel, node.lineno, "JTS103",
+                f"{name}() over a device value is an implicit "
+                "unguarded sync; fetch via "
+                "_platform.guarded_device_get first"))
+
+
+class DeviceSyncAnalyzer(Analyzer):
+    name = "device-sync"
+    codes = ("JTS101", "JTS102", "JTS103")
+
+    def scope(self, sf: SourceFile) -> bool:
+        return (sf.rel.startswith("jepsen_tpu/checker/")
+                or sf.rel == "jepsen_tpu/service.py")
+
+    def check_file(self, sf: SourceFile) -> list[Finding]:
+        if sf.tree is None:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                ft = _FunctionTaint(sf, findings)
+                for stmt in node.body:
+                    ft.visit(stmt)
+        # dedup: nested defs are visited by both their own walk entry
+        # and the enclosing function's body visit
+        return sorted(set(findings),
+                      key=lambda f: (f.line, f.code, f.message))

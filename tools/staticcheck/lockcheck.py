@@ -1,0 +1,301 @@
+"""Lock-discipline analyzer (JTS20x): statically verify that
+annotated shared state is only touched under its lock.
+
+The daemon modules (`service.py`, `telemetry.py`, `store.py`,
+`trace.py`) share mutable state across threads; PR 8 already shipped
+one such race (`Journal.subscribe`'s async unsubscribe). No test
+exhaustively pins lock discipline — so it is *declared* and checked:
+
+  * ``self.attr = ...  # guarded-by: <lock>`` on the attribute's
+    initialisation declares that every later read/write of
+    ``self.attr`` in that class must be lexically inside a
+    ``with self.<lock>:`` block, inside ``__init__``/``__new__``
+    (single-threaded construction), or inside a method annotated
+    ``def m(...):  # holds: <lock>`` (callers own the lock).
+  * Module-level ``NAME = ...  # guarded-by: <lock>`` does the same
+    for module globals under a module-level ``with <lock>:``.
+
+  JTS201  annotated attribute accessed without its lock
+  JTS202  lock-order inversion: `with A: with B:` somewhere and
+          `with B: with A:` somewhere else in the same module
+  JTS203  annotation names a lock the class/module never assigns
+
+Known lexical limits (documented in doc/static_analysis.md): accesses
+through a different object (``child.value`` from the registry) and
+closures that escape their ``with`` block are not checked; deliberate
+lock-free fast paths carry an explanatory ``# noqa: JTS201``."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .base import Analyzer, Finding, SourceFile
+
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
+INIT_METHODS = {"__init__", "__new__"}
+
+
+def _outermost_functions(tree: ast.AST):
+    """Function defs not nested inside another function (module-level
+    defs and class methods at any class depth)."""
+    stack = list(ast.iter_child_nodes(tree))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        else:
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.guarded: dict[str, tuple[str, int]] = {}  # attr -> (lock, line)
+        self.assigned_attrs: set[str] = set()
+
+
+def _holds_for(sf: SourceFile, fn: ast.FunctionDef) -> set[str]:
+    """Locks a `# holds:` annotation declares for a def — on the def
+    line itself or the comment line directly above it."""
+    for ln in (fn.lineno, fn.lineno - 1):
+        if 1 <= ln <= len(sf.lines):
+            m = HOLDS_RE.search(sf.lines[ln - 1])
+            if m:
+                return {t.strip() for t in m.group(1).split(",")}
+    return set()
+
+
+class _Walker:
+    """Lexical walk of one function, tracking held annotated locks."""
+
+    def __init__(self, analyzer: "LockAnalyzer", sf: SourceFile,
+                 cls: _ClassInfo | None, fn: ast.FunctionDef,
+                 findings: list[Finding]):
+        self.a = analyzer
+        self.sf = sf
+        self.cls = cls
+        self.fn = fn
+        self.findings = findings
+        self.holds = _holds_for(sf, fn)
+        self.held: list[tuple[str, str]] = []   # (owner, lock)
+
+    def _owner(self) -> str:
+        return self.cls.name if self.cls else "<module>"
+
+    def walk(self, node: ast.AST) -> None:
+        if isinstance(node, ast.With):
+            entered = []
+            for item in node.items:
+                ctx = item.context_expr
+                lock = _self_attr(ctx)
+                is_lock = (lock is not None and self.cls is not None
+                           and lock in self.a.class_locks.get(
+                               self.cls.name, set()))
+                if not is_lock:
+                    # a with-item that is NOT a lock acquisition is an
+                    # ordinary access (`with self._fh:`) — check it
+                    # under the locks held so far (items acquire
+                    # left-to-right)
+                    for sub in ast.walk(ctx):
+                        self._check_access(sub)
+                owner = None
+                if is_lock:
+                    owner = self.cls.name
+                elif isinstance(ctx, ast.Name) \
+                        and ctx.id in self.a.module_locks:
+                    owner, lock = "<module>", ctx.id
+                if owner is not None:
+                    for prev in self.held:
+                        self.a.order_pairs.setdefault(
+                            (prev, (owner, lock)), node.lineno)
+                    self.held.append((owner, lock))
+                    entered.append((owner, lock))
+            for child in node.body:
+                self.walk(child)
+            for _ in entered:
+                self.held.pop()
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not self.fn:
+            # nested def: checked in its own right by the caller; its
+            # body inherits the lexical with-state (closures that run
+            # later are a documented limit)
+            for child in node.body:
+                self.walk(child)
+            return
+        self._check_access(node)
+        for child in ast.iter_child_nodes(node):
+            self.walk(child)
+
+    def _check_access(self, node: ast.AST) -> None:
+        if self.cls is not None:
+            attr = _self_attr(node)
+            if attr is not None and attr in self.cls.guarded:
+                lock, _ = self.cls.guarded[attr]
+                if self.fn.name in INIT_METHODS:
+                    return
+                if lock in self.holds:
+                    return
+                if (self.cls.name, lock) in self.held:
+                    return
+                self.findings.append(Finding(
+                    self.sf.rel, node.lineno, "JTS201",
+                    f"'{self.cls.name}.{attr}' is guarded by "
+                    f"'self.{lock}' but accessed outside it (wrap in "
+                    f"'with self.{lock}:' or annotate the method "
+                    f"'# holds: {lock}')"))
+        if isinstance(node, ast.Name) \
+                and node.id in self.a.module_guarded:
+            lock = self.a.module_guarded[node.id][0]
+            if lock in self.holds or ("<module>", lock) in self.held:
+                return
+            if node.id == lock:
+                return
+            self.findings.append(Finding(
+                self.sf.rel, node.lineno, "JTS201",
+                f"module global '{node.id}' is guarded by '{lock}' "
+                f"but accessed outside 'with {lock}:'"))
+
+
+class LockAnalyzer(Analyzer):
+    name = "locks"
+    codes = ("JTS201", "JTS202", "JTS203")
+
+    def check_file(self, sf: SourceFile) -> list[Finding]:
+        if sf.tree is None:
+            return []
+        findings: list[Finding] = []
+        self.module_guarded: dict[str, tuple[str, int]] = {}
+        self.module_locks: set[str] = set()
+        self.class_locks: dict[str, set[str]] = {}
+        self.order_pairs: dict[tuple, int] = {}
+        classes: list[tuple[ast.ClassDef, _ClassInfo]] = []
+
+        # -- collect annotations --------------------------------------------
+        for node in ast.iter_child_nodes(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                ci = _ClassInfo(node.name)
+                for sub in ast.walk(node):
+                    tgts = []
+                    if isinstance(sub, ast.Assign):
+                        tgts = sub.targets
+                    elif isinstance(sub, ast.AnnAssign):
+                        tgts = [sub.target]
+                    for t in tgts:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        ci.assigned_attrs.add(attr)
+                        m = GUARD_RE.search(
+                            sf.lines[sub.lineno - 1]) \
+                            if sub.lineno <= len(sf.lines) else None
+                        if m:
+                            ci.guarded[attr] = (m.group(1), sub.lineno)
+                classes.append((node, ci))
+                self.class_locks[node.name] = {
+                    lock for lock, _ in ci.guarded.values()}
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                tgt = node.targets[0] if isinstance(node, ast.Assign) \
+                    else node.target
+                if isinstance(tgt, ast.Name) \
+                        and node.lineno <= len(sf.lines):
+                    m = GUARD_RE.search(sf.lines[node.lineno - 1])
+                    if m:
+                        self.module_guarded[tgt.id] = (m.group(1),
+                                                       node.lineno)
+
+        # same-module inheritance: a subclass inherits the base's
+        # guarded-attr declarations and lock assignments (telemetry's
+        # _Child hierarchy declares `value # guarded-by: _lock` once)
+        by_name = {node.name: (node, ci) for node, ci in classes}
+        for node, ci in classes:
+            seen_bases: set[str] = set()
+            stack = [b.id for b in node.bases
+                     if isinstance(b, ast.Name)]
+            while stack:
+                bname = stack.pop()
+                if bname in seen_bases or bname not in by_name:
+                    continue
+                seen_bases.add(bname)
+                bnode, bci = by_name[bname]
+                for attr, ann in bci.guarded.items():
+                    ci.guarded.setdefault(attr, ann)
+                ci.assigned_attrs |= bci.assigned_attrs
+                stack.extend(b.id for b in bnode.bases
+                             if isinstance(b, ast.Name))
+            self.class_locks[node.name] = {
+                lock for lock, _ in ci.guarded.values()}
+
+        self.module_locks = {lock for lock, _
+                             in self.module_guarded.values()}
+        module_names = {t.id for n in ast.iter_child_nodes(sf.tree)
+                        if isinstance(n, ast.Assign)
+                        for t in n.targets if isinstance(t, ast.Name)}
+
+        # -- JTS203: annotation sanity --------------------------------------
+        for _, ci in classes:
+            for attr, (lock, line) in ci.guarded.items():
+                if lock not in ci.assigned_attrs:
+                    findings.append(Finding(
+                        sf.rel, line, "JTS203",
+                        f"'# guarded-by: {lock}' on "
+                        f"'{ci.name}.{attr}' but the class never "
+                        f"assigns 'self.{lock}'"))
+        for name, (lock, line) in self.module_guarded.items():
+            if lock not in module_names:
+                findings.append(Finding(
+                    sf.rel, line, "JTS203",
+                    f"'# guarded-by: {lock}' on module global "
+                    f"'{name}' but the module never assigns "
+                    f"'{lock}'"))
+
+        # -- access + ordering walk -----------------------------------------
+        walked: set[int] = set()
+        for node, ci in classes:
+            if not ci.guarded:
+                continue
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    walked.add(id(sub))
+                    w = _Walker(self, sf, ci, sub, findings)
+                    for stmt in sub.body:
+                        w.walk(stmt)
+        if self.module_guarded:
+            # outermost functions only: _Walker descends into nested
+            # defs itself, so walking every FunctionDef from ast.walk
+            # would double-report accesses inside closures. Guarded-
+            # class methods were walked above with class context (that
+            # walk checks module globals too) — walking them again
+            # would double-report those.
+            for node in _outermost_functions(sf.tree):
+                if id(node) in walked:
+                    continue
+                w = _Walker(self, sf, None, node, findings)
+                for stmt in node.body:
+                    w.walk(stmt)
+
+        # -- JTS202: inversions ---------------------------------------------
+        reported = set()
+        for (a, b), line in sorted(self.order_pairs.items(),
+                                   key=lambda kv: kv[1]):
+            if (b, a) in self.order_pairs and (b, a) not in reported:
+                reported.add((a, b))
+                findings.append(Finding(
+                    sf.rel, max(line, self.order_pairs[(b, a)]),
+                    "JTS202",
+                    f"lock-order inversion: {a[0]}.{a[1]} -> "
+                    f"{b[0]}.{b[1]} here but {b[0]}.{b[1]} -> "
+                    f"{a[0]}.{a[1]} at line "
+                    f"{min(line, self.order_pairs[(b, a)])}"))
+        return findings

@@ -1,0 +1,329 @@
+"""Retrace-hazard analyzer (JTS30x): keep every jitted kernel's trace
+signature stable.
+
+The checking kernels are compiled once per *shape bucket* (`_bucket`
+rounds capacities to powers of two) and cached — the persistent
+compile cache, `wgl.select_engine`'s cost model, and the PR 10
+chunk-latency telemetry all assume a dispatch is a cache hit. A
+retrace hazard silently violates that: the chunk histogram measures a
+recompile, the cost model prices a kernel that is being rebuilt, and
+the pin-hot assumption behind the daemon dies.
+
+Scope: ``jepsen_tpu/checker/`` (the kernel-bearing modules named by
+doc/static_analysis.md: wgl.py, wgl_dedup.py, elle/kernels.py,
+streaming.py, plus their siblings).
+
+  JTS301  jit-captured mutable module state: a ``@jax.jit`` function
+          reads a module global that is reassigned somewhere (via a
+          ``global`` statement or multiple module-level bindings) —
+          the traced value is frozen at first compile, later writes
+          are silently ignored (or force retraces via closure
+          invalidation).
+  JTS302  Python branch on a traced value: ``if``/``while`` on a
+          parameter of a jit function (static properties —
+          ``.shape``/``.dtype``/``.ndim``/``len()``/``isinstance``
+          — are exempt).
+  JTS303  unstable scalar signature: a call to a kernel entry
+          (``k.check`` / ``check_stream_chunk`` / ... or a callable
+          bound from a kernel factory) passing a bare Python numeric
+          literal or ``int(...)``/``len(...)`` result where the
+          repo's convention is a ``jnp.int32(...)``-wrapped operand —
+          weak-type promotion gives the bare scalar a *different*
+          trace signature, so one entry compiles twice.
+  JTS304  unbucketed batch stack: an ``np.stack``/``np.concatenate``
+          batch assembled from a dynamic-length list reaches a jit
+          dispatch without its leading dimension passing through
+          ``_bucket`` padding — every distinct batch count is a fresh
+          XLA compile."""
+
+from __future__ import annotations
+
+import ast
+
+from .base import (Analyzer, Finding, SourceFile, attr_name, call_root,
+                   names_in)
+from .devicesync import ENTRY_NAMES, FACTORY_NAMES
+from .lockcheck import _outermost_functions
+
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "weak_type"}
+STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr"}
+#: functions known to forward their array arguments straight into a
+#: jit dispatch (extends the entry/factory sets for JTS304)
+TRACED_SINKS = {"_classify_batches"}
+BUCKET_FNS = {"_bucket", "table_size"}
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> bool:
+    for d in fn.decorator_list:
+        if isinstance(d, ast.Attribute) and d.attr == "jit":
+            return True
+        if isinstance(d, ast.Name) and d.id == "jit":
+            return True
+        if isinstance(d, ast.Call) and attr_name(d) in {"jit"}:
+            return True
+    return False
+
+
+def _mutated_globals(tree: ast.AST) -> set[str]:
+    """Module-level names that are mutable state: rebound via a
+    ``global`` statement, bound more than once at module level, or
+    augmented-assigned at module level."""
+    declared_global: set[str] = set()
+    counts: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+    for node in ast.iter_child_nodes(tree):
+        tgts = []
+        if isinstance(node, ast.Assign):
+            tgts = [t for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            tgts = [node.target]
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            declared_global.add(node.target.id)
+        for t in tgts:
+            counts[t.id] = counts.get(t.id, 0) + 1
+    multi = {n for n, c in counts.items() if c > 1}
+    return declared_global | multi
+
+
+def _params(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class _Parented(ast.NodeVisitor):
+    def __init__(self):
+        self.parent: dict[int, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for c in ast.iter_child_nodes(node):
+            self.parent[id(c)] = node
+        super().generic_visit(node)
+
+
+def _traced_name_used(test: ast.AST, traced: set[str]) -> bool:
+    """A traced name is *used as a value* in the test — not merely via
+    a static property (x.shape, len(x), isinstance(x, ...))."""
+    p = _Parented()
+    p.visit(test)
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced):
+            continue
+        par = p.parent.get(id(node))
+        if isinstance(par, ast.Attribute) and par.attr in STATIC_ATTRS:
+            continue
+        if isinstance(par, ast.Call) and node in par.args \
+                and isinstance(par.func, ast.Name) \
+                and par.func.id in STATIC_CALLS:
+            continue
+        return True
+    return False
+
+
+def _scalar_hazard(arg: ast.AST) -> bool:
+    """A bare Python scalar expression (weak-typed under tracing)."""
+    if isinstance(arg, ast.Constant) \
+            and isinstance(arg.value, (int, float)) \
+            and not isinstance(arg.value, bool):
+        return True
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) \
+            and arg.func.id in {"int", "len"}:
+        return True
+    if isinstance(arg, ast.UnaryOp):
+        return _scalar_hazard(arg.operand)
+    return False
+
+
+class RetraceAnalyzer(Analyzer):
+    name = "retrace"
+    codes = ("JTS301", "JTS302", "JTS303", "JTS304")
+
+    def scope(self, sf: SourceFile) -> bool:
+        return sf.rel.startswith("jepsen_tpu/checker/")
+
+    def check_file(self, sf: SourceFile) -> list[Finding]:
+        if sf.tree is None:
+            return []
+        findings: list[Finding] = []
+        mutated = _mutated_globals(sf.tree)
+        for fn in [n for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            if _is_jit_decorated(fn):
+                self._check_jit_fn(sf, fn, mutated, findings)
+        # call-site checks walk nested defs themselves (their assigns
+        # maps need the enclosing scope), so run them only on
+        # outermost functions — else nested-def calls report twice
+        for fn in _outermost_functions(sf.tree):
+            self._check_call_sites(sf, fn, findings)
+        return findings
+
+    # -- JTS301 / JTS302 ----------------------------------------------------
+
+    def _check_jit_fn(self, sf: SourceFile, fn: ast.FunctionDef,
+                      mutated: set[str], findings: list[Finding]) -> None:
+        local = _params(fn) | {
+            t.id for n in ast.walk(fn) if isinstance(n, ast.Assign)
+            for t in n.targets if isinstance(t, ast.Name)}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in mutated and node.id not in local:
+                findings.append(Finding(
+                    sf.rel, node.lineno, "JTS301",
+                    f"jit function '{fn.name}' closes over mutable "
+                    f"module state '{node.id}' — the traced value is "
+                    f"frozen at first compile; pass it as an "
+                    f"argument or resolve it outside the kernel "
+                    f"cache"))
+        traced = _params(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and _traced_name_used(node.test, traced):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "JTS302",
+                    f"Python branch on traced value inside jit "
+                    f"function '{fn.name}' — use lax.cond/jnp.where "
+                    f"(or branch on a static property)"))
+
+    # -- JTS303 / JTS304 ----------------------------------------------------
+
+    def _check_call_sites(self, sf: SourceFile, fn: ast.FunctionDef,
+                          findings: list[Finding]) -> None:
+        assigns: dict[str, list[ast.AST]] = {}
+        sub_assigns: dict[str, list[ast.AST]] = {}
+        jit_callables: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name):
+                # `padded += [pad] * (_bucket(...) - len(padded))` is
+                # how the dispatch sites bucket their batch axis
+                assigns.setdefault(node.target.id,
+                                   []).append(node.value)
+                continue
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigns.setdefault(t.id, []).append(node.value)
+                    if isinstance(node.value, ast.Call) \
+                            and attr_name(node.value) in FACTORY_NAMES:
+                        jit_callables.add(t.id)
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    sub_assigns.setdefault(t.value.id,
+                                           []).append(node.value)
+
+        # names whose value is derived from a _bucket(...) result
+        buckety: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, rhss in assigns.items():
+                if name in buckety:
+                    continue
+                for rhs in rhss:
+                    if self._bucket_derived(rhs, buckety):
+                        buckety.add(name)
+                        changed = True
+                        break
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            is_entry = (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ENTRY_NAMES) \
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in jit_callables)
+            is_sink = is_entry or (isinstance(node.func, ast.Name)
+                                   and node.func.id in TRACED_SINKS)
+            if is_entry:
+                for arg in node.args:
+                    if _scalar_hazard(arg):
+                        findings.append(Finding(
+                            sf.rel, node.lineno, "JTS303",
+                            f"bare Python scalar at jit entry "
+                            f"'{attr_name(node)}' — wrap in "
+                            f"jnp.int32(...) (weak-type promotion "
+                            f"gives this call its own trace "
+                            f"signature)"))
+            if is_sink:
+                self._check_stacks(sf, node, assigns, sub_assigns,
+                                   buckety, findings)
+
+    def _bucket_derived(self, expr: ast.AST, buckety: set[str]) -> bool:
+        for c in ast.walk(expr):
+            if isinstance(c, ast.Call) and attr_name(c) in BUCKET_FNS:
+                return True
+            if isinstance(c, ast.Name) and c.id in buckety:
+                return True
+        return False
+
+    #: wrappers a staged batch flows through on its way to a dispatch
+    PASSTHROUGH = {"asarray", "device_put", "maybe_corrupt"}
+
+    def _check_stacks(self, sf: SourceFile, call: ast.Call,
+                      assigns: dict, sub_assigns: dict,
+                      buckety: set[str],
+                      findings: list[Finding]) -> None:
+        seen: set[str] = set()
+
+        def visit(node: ast.AST, is_root: bool) -> None:
+            if isinstance(node, ast.Call):
+                if call_root(node.func) in {"np", "numpy", "jnp"} \
+                        and attr_name(node) in {"stack",
+                                                "concatenate"}:
+                    if not self._stack_bucketed(node, assigns,
+                                                buckety):
+                        findings.append(Finding(
+                            sf.rel, node.lineno, "JTS304",
+                            f"dynamic {attr_name(node)}() batch "
+                            f"reaches a jit dispatch without "
+                            f"_bucket padding — every distinct "
+                            f"batch count is a fresh XLA compile"))
+                    for a in node.args:
+                        visit(a, False)
+                elif attr_name(node) in self.PASSTHROUGH:
+                    for a in node.args:
+                        visit(a, False)
+                # any other call is opaque: its result's shape is its
+                # own business (it re-chunks, re-buckets, or is host)
+                return
+            if isinstance(node, ast.Name):
+                if node.id in seen:
+                    return
+                seen.add(node.id)
+                for rhs in assigns.get(node.id, []):
+                    # a sliced result no longer carries the stack's
+                    # dynamic length
+                    if not isinstance(rhs, ast.Subscript):
+                        visit(rhs, False)
+                if is_root:
+                    for rhs in sub_assigns.get(node.id, []):
+                        visit(rhs, False)
+                return
+            for c in ast.iter_child_nodes(node):
+                visit(c, is_root)
+
+        for a in call.args:
+            visit(a, True)
+
+    def _stack_bucketed(self, stack: ast.Call, assigns: dict,
+                        buckety: set[str]) -> bool:
+        """The stacked operand's length is visibly bucket-padded:
+        the stack subtree (or the one-step definition of a name it
+        references) involves a _bucket-derived value."""
+        if self._bucket_derived(stack, buckety):
+            return True
+        for name in names_in(stack):
+            for rhs in assigns.get(name, []):
+                if self._bucket_derived(rhs, buckety):
+                    return True
+        return False

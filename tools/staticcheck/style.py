@@ -1,0 +1,167 @@
+"""Style/import analyzer (JTS00x) — the old tools/lint.py checks,
+migrated onto the shared driver (the reference gates every push on
+`lein eastwood`, `.travis.yml:1-11`; no third-party linter exists in
+this image, so the checks that matter are implemented here).
+
+Per file:
+
+  JTS001  syntax error (ast.parse)
+  JTS002  unused import — an imported name never referenced in the
+          module. Names used only inside *string annotations*
+          (``x: "Optional[int]"``, forward refs nested in real
+          annotations) count as used: the old pass missed them and
+          forced ``# noqa`` noise on typing-only imports.
+  JTS003  duplicate toplevel import of the same dotted name
+  JTS004  trailing whitespace
+  JTS005  tab in indentation
+  JTS006  line longer than MAX_LINE columns
+
+Keeps tools/lint.py's legacy suppression rule: any ``# noqa`` mention
+on the line exempts it (so existing ``# noqa: F401``-style re-export
+exemptions keep working)."""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Analyzer, Finding, SourceFile
+
+MAX_LINE = 100
+
+
+def _imported_names(tree: ast.AST):
+    """Yield (lineno, bound-name, dotted, is-future, is-toplevel) for
+    every import binding. Function-local imports are idiomatic in this
+    codebase (they defer jax init), so duplicate detection only looks
+    at the is-toplevel subset."""
+    toplevel = set()
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            toplevel.add(id(node))
+    for node in ast.walk(tree):
+        top = id(node) in toplevel
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                # dedup on the full dotted path: `import urllib.error`
+                # and `import urllib.request` both bind `urllib` but
+                # are distinct imports
+                yield node.lineno, bound, a.asname or a.name, False, top
+        elif isinstance(node, ast.ImportFrom):
+            future = node.module == "__future__"
+            prefix = f"{node.module}." if node.module else ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound = a.asname or a.name
+                yield (node.lineno, bound, prefix + a.name, future, top)
+
+
+def _annotation_exprs(tree: ast.AST):
+    """Every annotation expression position in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            yield node.annotation
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.returns is not None:
+                yield node.returns
+            args = node.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs
+                      + ([args.vararg] if args.vararg else [])
+                      + ([args.kwarg] if args.kwarg else [])):
+                if a.annotation is not None:
+                    yield a.annotation
+
+
+def _names_in_string_annotations(tree: ast.AST) -> set[str]:
+    """Names referenced only inside string annotations ("Optional[X]"
+    as a quoted forward reference, or quoted pieces nested inside a
+    real annotation expression). The old unused-import pass could not
+    see these — the false-positive class this fixes."""
+    used: set[str] = set()
+    pending = list(_annotation_exprs(tree))
+    while pending:
+        expr = pending.pop()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                v = n
+                while isinstance(v, ast.Attribute):
+                    v = v.value
+                if isinstance(v, ast.Name):
+                    used.add(v.id)
+            elif (isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)):
+                try:
+                    pending.append(ast.parse(n.value, mode="eval").body)
+                except SyntaxError:
+                    pass   # a plain string (Literal["a"], doc text)
+    return used
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # names exported via __all__ count as used
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    used.add(c.value)
+    used |= _names_in_string_annotations(tree)
+    return used
+
+
+class StyleAnalyzer(Analyzer):
+    name = "style"
+    codes = ("JTS001", "JTS002", "JTS003", "JTS004", "JTS005",
+             "JTS006")
+    legacy_noqa = True
+
+    def check_file(self, sf: SourceFile) -> list[Finding]:
+        if sf.syntax_error is not None:
+            e = sf.syntax_error
+            return [Finding(sf.rel, e.lineno or 1, "JTS001",
+                            f"syntax error: {e.msg}")]
+        out: list[Finding] = []
+        tree = sf.tree
+        used = _used_names(tree)
+        seen: dict[str, int] = {}
+        for lineno, name, dotted, future, top in _imported_names(tree):
+            if future:
+                continue
+            if top:
+                key = f"{dotted} as {name}"
+                if key in seen and seen[key] != lineno:
+                    out.append(Finding(
+                        sf.rel, lineno, "JTS003",
+                        f"duplicate import of {dotted!r} "
+                        f"(first at line {seen[key]})"))
+                seen.setdefault(key, lineno)
+            if name not in used and not name.startswith("_"):
+                out.append(Finding(sf.rel, lineno, "JTS002",
+                                   f"unused import {name!r}"))
+        for i, line in enumerate(sf.lines, 1):
+            if line != line.rstrip():
+                out.append(Finding(sf.rel, i, "JTS004",
+                                   "trailing whitespace"))
+            body = line[:len(line) - len(line.lstrip())]
+            if "\t" in body:
+                out.append(Finding(sf.rel, i, "JTS005",
+                                   "tab in indentation"))
+            if len(line) > MAX_LINE:
+                out.append(Finding(
+                    sf.rel, i, "JTS006",
+                    f"line too long ({len(line)} > {MAX_LINE})"))
+        return out
