@@ -24,14 +24,15 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # fast pre-gate: staticcheck plus the tier-1 screen + ABFT attestation
-# suites and the telemetry registry/exposition suite (seconds, no
-# kernel compiles beyond the small fault matrices) — run before the
-# full tier-1 sweep so a broken invariant/observability/structural
-# layer fails in the first minute, not the fortieth. CI runs this
-# first.
+# suites, the telemetry registry/exposition suite, and the adaptive
+# overload-control suite (seconds, no kernel compiles beyond the small
+# fault matrices) — run before the full tier-1 sweep so a broken
+# invariant/observability/structural/scheduling layer fails in the
+# first minute, not the fortieth. CI runs this first.
 tier0: staticcheck
 	$(PY) -m pytest tests/test_screen.py tests/test_attest.py \
-		tests/test_telemetry.py tests/test_staticcheck.py -q
+		tests/test_telemetry.py tests/test_staticcheck.py \
+		tests/test_adaptive.py -q
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
 # holds the larger shape sweeps, e.g. the pallas dedup parity sweep).

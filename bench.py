@@ -901,6 +901,140 @@ def section_service():
     }}
 
 
+def section_adaptive():
+    """Static vs adaptive budget under a 16-stream overload mix (the
+    ISSUE-12 control plane, doc/robustness.md `Adaptive overload
+    control`): a deliberately tight device-seconds budget serves a
+    half-cheap / half-expensive stream mix, once with the AIMD
+    controller + degradation ladder on and once frozen
+    (`adaptive=False` — the `--static-budget` posture).
+
+    What the A/B shows: with the ladder on, the service stays live
+    (bounded status-verb latency while saturated) by deferring clean
+    expensive streams' device verdicts to offline; frozen, every
+    stream grinds through the same contended budget. Verdict
+    accounting (full vs deferred vs shed) keeps the comparison honest
+    — a deferred verdict is cheaper because it did less, and the
+    numbers say so out loud."""
+    import json as _json
+    import threading as _threading
+
+    from jepsen_tpu import service as _service, store as _store
+    from jepsen_tpu.checker import synth
+
+    model = _model()
+    smoke = N_OPS < DEFAULT_N_OPS // 4
+    n_streams = 8 if smoke else 16
+    n = max(N_OPS // 25, 400)
+
+    def jops(h):
+        return [_json.loads(_json.dumps(op,
+                                        default=_store._json_default))
+                for op in h.ops]
+
+    def spec(expensive):
+        # the expensive half: 4x chunk and 2 extra slot doublings
+        return {
+            "linear": {"kind": "wgl",
+                       "model": _service.model_spec(model),
+                       "chunk-entries": 256 if expensive else 64,
+                       "slots": 10 if expensive else 8,
+                       "engine": "sort", "frontier": 128,
+                       "checkpoint-every": 4},
+            "screen-linear": {"kind": "screen",
+                              "model": _service.model_spec(model)},
+        }
+
+    hists = [jops(synth.register_history(n, concurrency=3, values=5,
+                                         seed=900 + i))
+             for i in range(n_streams)]
+
+    def drive(adaptive):
+        svc = _service.VerificationService(
+            max_streams=n_streams + 4,
+            budget_elementops=2e7,   # tight: sustained contention
+            adaptive=adaptive,
+            ladder_tick_s=0.05,
+            ladder_climb_hold_s=0.3,
+            ladder_descend_hold_s=0.9)
+        for i in range(n_streams):
+            svc.admit(f"s{i}", spec(i % 2 == 0))
+        verb_lat: list = []
+        stop = _threading.Event()
+
+        def probe():
+            # the liveness probe: /healthz-shaped status() under load
+            while not stop.is_set():
+                t0 = time.monotonic()
+                svc.status()
+                verb_lat.append(time.monotonic() - t0)
+                stop.wait(0.05)
+
+        results: dict = {}
+
+        def feed(i):
+            for op in hists[i]:
+                svc.offer(f"s{i}", op)
+            svc.seal(f"s{i}")
+            results[i] = svc.result(f"s{i}", timeout_s=600)
+
+        prober = _threading.Thread(target=probe, daemon=True)
+        prober.start()
+        t0 = time.monotonic()
+        ths = [_threading.Thread(target=feed, args=(i,))
+               for i in range(n_streams)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        wall = time.monotonic() - t0
+        stop.set()
+        prober.join(timeout=5)
+        st = svc.status()
+        svc.stop()
+        full = sum(1 for r in results.values()
+                   if r.get("linear", {}).get("valid?") is True)
+        deferred = sum(1 for r in results.values()
+                       if r.get("linear", {}).get("deferred"))
+        shed = n_streams - len([r for r in results.values() if r])
+        return {
+            "wall_s": round(wall, 3),
+            "full_verdicts": full,
+            "deferred_verdicts": deferred,
+            "shed_or_lost": shed,
+            "ladder_transitions":
+                st.get("ladder", {}).get("transitions", 0),
+            "budget_cuts": st.get("budget", {}).get("cuts", 0),
+            "budget_capacity_fraction": round(
+                st["budget"]["capacity"] / st["budget"]["initial"], 3),
+            "status_p_max_ms": round(max(verb_lat) * 1e3, 1)
+            if verb_lat else None,
+            "calibration":
+                st.get("calibration", {}).get("coefficients", {}),
+        }
+
+    # warm both kernel shapes outside the timed A/B (whichever mode
+    # ran first would otherwise pay every compile)
+    warm = _service.VerificationService(max_streams=4)
+    for i in (0, 1):
+        warm.admit(f"warm{i}", spec(i % 2 == 0))
+        for op in hists[i][:120]:
+            warm.offer(f"warm{i}", op)
+        warm.seal(f"warm{i}")
+        warm.result(f"warm{i}", timeout_s=300)
+    warm.stop()
+
+    static = drive(False)
+    adaptive = drive(True)
+    return {"adaptive": {
+        "shape": f"{n_streams} streams ({n_streams // 2} cheap chunk-"
+                 f"64 + {n_streams // 2} expensive chunk-256) x {n} "
+                 f"ops, budget 2e7 elementops",
+        "static": static,
+        "adaptive": adaptive,
+    }}
+
+
 def section_telemetry():
     """Instrumentation overhead: the chunked 10k-op WGL path with the
     metrics registry on vs off, pinned to the CPU backend (the
@@ -1038,6 +1172,7 @@ SECTIONS = [
     ("config4", section_config4, 900, True),
     ("config5", section_config5, 1200, True),
     ("service", section_service, 600, True),
+    ("adaptive", section_adaptive, 600, True),
     ("telemetry", section_telemetry, 420, False),
     ("generator", section_generator, 180, False),
 ]

@@ -161,14 +161,8 @@ def price_escalation(model, hist) -> dict | None:
         p = wgl.required_slots(ops)
         srange = wgl._state_range(model.device_model, model, [ops])
         dec = wgl.select_engine(srange, p, wgl.event_count(ops))
-        if dec.family == "dense":
-            cost = dec.costs["dense"]
-        elif dec.dedup == wgl.DEDUP_PALLAS:
-            cost = dec.costs["hash"]
-        else:
-            cost = dec.costs["sort"]
         return {"family": dec.family, "dedup": dec.dedup,
-                "reason": dec.reason, "cost": float(cost)}
+                "reason": dec.reason, "cost": wgl.engine_cost(dec)}
     except Exception:  # noqa: BLE001 — pricing is advisory
         return None
 
@@ -428,6 +422,16 @@ class WrScreen:
                 self._ws._g1a or self._ws._g1b or self._ws._internal
                 or self._ws._duplicates):
             self.violation = True
+
+    @property
+    def suspicion(self) -> float:
+        """Live suspicion from the single-pass cases (the SCC cycle
+        check only runs at finish — a mid-stream score can grow at
+        finish, never shrink). The service's suspicion-priority
+        scheduling reads this while the stream is still feeding."""
+        ws = self._ws
+        return float(len(ws._g1a) + len(ws._g1b)
+                     + len(ws._internal) + len(ws._duplicates))
 
     def finish(self) -> dict:
         import numpy as np

@@ -820,6 +820,14 @@ class WglStream:
             return 0
         return self.encoder.available() // self.chunk
 
+    def kernel_key(self):
+        """Identity of the (process-LRU-cached, shape-shared) jitted
+        kernel, or None before setup. The service's calibration feed
+        uses it to tell which ONE stream per kernel shape paid the
+        compile on its first chunk — only that stream's lagged sample
+        is compile-tainted."""
+        return id(self._k) if self._k is not None else None
+
     def pump(self, max_chunks: int | None = None) -> int:
         """Dispatch up to max_chunks full chunks (None = all). The
         external-pump entry for a verification service; with

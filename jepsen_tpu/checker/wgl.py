@@ -59,7 +59,7 @@ from typing import Callable
 
 import numpy as np
 
-from .. import telemetry as _telemetry
+from .. import calibrate as _calibrate, telemetry as _telemetry
 from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST,
                          FAULT_OOM, attest_enabled, backend_reinit,
                          classify_backend_error, guarded_device_get,
@@ -1284,6 +1284,25 @@ class EngineDecision:
     dedup: str                  # DEDUP_* (sort family's dedup engine)
     reason: str
     costs: dict                 # modeled per-history element-ops
+    # measured per-history device-seconds per compared variant, when a
+    # ready calibration priced the decision (see jepsen_tpu.calibrate)
+    seconds: dict | None = None
+
+
+def engine_variant(dec: "EngineDecision") -> str:
+    """The calibration variant a decision actually runs: 'dense', or
+    the sort family at its resolved dedup engine ('hash' for the
+    Pallas kernel, 'sort' for the XLA lex-sort)."""
+    if dec.family == "dense":
+        return "dense"
+    return "hash" if dec.dedup == DEDUP_PALLAS else "sort"
+
+
+def engine_cost(dec: "EngineDecision") -> float:
+    """The chosen engine's modeled element-ops — the single place the
+    family/dedup -> costs-key mapping lives (the screen's escalation
+    pricing and the service's chunk budget both use it)."""
+    return float(dec.costs.get(engine_variant(dec)) or 0.0)
 
 
 def _family_costs(S: int, p_dense: int, p_sort: int, F: int,
@@ -1311,23 +1330,22 @@ def _family_costs(S: int, p_dense: int, p_sort: int, F: int,
 
 def _note_engine(dec: "EngineDecision", reason: str) -> "EngineDecision":
     """Count a select_engine outcome. `reason` is the COARSE bucket
-    (forced | slot-cap | dense-caps | cost-model) — the free-text
-    dec.reason would blow up label cardinality. Also accumulates the
+    (forced | slot-cap | dense-caps | cost-model | calibrated) — the
+    free-text dec.reason would blow up label cardinality. Also accumulates the
     chosen engine's modeled element-ops, so rate(elementops)/rate(
     chunk_seconds) is the pipeline's modeled throughput."""
     _M_ENGINE.labels(family=dec.family, dedup=dec.dedup,
                      reason=reason).inc()
-    cost = dec.costs.get("dense") if dec.family == "dense" else \
-        dec.costs.get("hash" if dec.dedup == DEDUP_PALLAS else "sort")
+    cost = engine_cost(dec)
     if cost:
-        _M_ELEMENTOPS.labels(family=dec.family).inc(float(cost))
+        _M_ELEMENTOPS.labels(family=dec.family).inc(cost)
     return dec
 
 
 def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
                   *, slots: int | None = None, frontier: int = 256,
                   engine: str = "auto", dense_slot_cap: int | None = None,
-                  pallas=None) -> EngineDecision:
+                  pallas=None, calibration=None) -> EngineDecision:
     """Pick the kernel family (and the sort family's dedup engine) for
     one history shape. engine='dense'/'sort' force a family ('dense'
     raises _dense_caps_error when the table cannot fit, the offline
@@ -1335,7 +1353,14 @@ def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
     slot count the dense table may be asked to absorb (each slot
     doubles the table; a checker that knows its histories' tail
     concurrency can cap the blowup early). pallas=True/False forces
-    the Pallas variants on/off (None = env gate / backend default)."""
+    the Pallas variants on/off (None = env gate / backend default).
+
+    calibration: a `jepsen_tpu.calibrate.Calibration` (None = the
+    process-wide active one, usually nothing). When it holds trusted
+    measured coefficients for BOTH compared variants, the dense-vs-
+    sort comparison runs in measured device-seconds instead of raw
+    modeled element-ops — the same DENSE_EXACT_BIAS preference for
+    exact verdicts, applied to ground truth."""
     if engine not in ("auto", "dense", "sort"):
         raise ValueError(f"unknown WGL engine {engine!r}")
     if slots is None:
@@ -1346,8 +1371,15 @@ def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
                          pallas)
     # the sort family's modeled cost is whichever dedup it will
     # actually run at this shape — the kernel never mixes engines
-    sort_cost = (costs["hash"] if dedup == DEDUP_PALLAS
-                 else costs["sort"])
+    sort_variant = "hash" if dedup == DEDUP_PALLAS else "sort"
+    sort_cost = costs[sort_variant]
+    cal = calibration if calibration is not None \
+        else _calibrate.active()
+    seconds = None
+    if cal is not None and cal.ready("dense", sort_variant):
+        seconds = {
+            "dense": cal.seconds("dense", costs["dense"]),
+            sort_variant: cal.seconds(sort_variant, sort_cost)}
     dense = None
     if engine in ("auto", "dense"):
         if dense_slot_cap is not None and p_exact > dense_slot_cap:
@@ -1367,8 +1399,23 @@ def select_engine(srange: tuple[int, int], p_exact: int, n_events: int,
         why = ("forced" if engine == "sort"
                else f"S={S} x 2^{p_exact} exceeds the dense caps")
         return _note_engine(
-            EngineDecision("sort", None, dedup, why, costs),
+            EngineDecision("sort", None, dedup, why, costs, seconds),
             "forced" if engine == "sort" else "dense-caps")
+    if seconds is not None:
+        # measured comparison: same exactness bias, ground-truth units
+        dense_v, sort_v = seconds["dense"], seconds[sort_variant]
+        if engine == "dense" or dense_v <= DENSE_EXACT_BIAS * sort_v:
+            why = ("forced" if engine == "dense" else
+                   f"measured dense {dense_v:.3g}s <= "
+                   f"{DENSE_EXACT_BIAS:g}x {dedup} {sort_v:.3g}s")
+            return _note_engine(
+                EngineDecision("dense", dense, DEDUP_NONE, why, costs,
+                               seconds),
+                "forced" if engine == "dense" else "calibrated")
+        return _note_engine(EngineDecision(
+            "sort", None, dedup,
+            f"measured dense {dense_v:.3g}s > {DENSE_EXACT_BIAS:g}x "
+            f"{dedup} {sort_v:.3g}s", costs, seconds), "calibrated")
     if engine == "dense" or \
             costs["dense"] <= DENSE_EXACT_BIAS * sort_cost:
         why = ("forced" if engine == "dense" else
@@ -1714,7 +1761,14 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
             # speculate one chunk past a death: an empty frontier stays
             # empty, and on death we discard the speculated carry.
             e = 0
+            # measured-cost-model feed: modeled element-ops per step
+            # entry, so each chunk's latency pairs with its share of
+            # the decision's modeled cost (both linear in entries)
+            cal_ops_per_entry = engine_cost(decision) / max(steps.n, 1)
+            chunk_i = 0
+            prev_span = 0
             while e < steps.n:
+                e0 = e
                 stop = min(e + chunk_entries, steps.n)
                 maybe_inject_fault("offline")
                 t_chunk = _time.monotonic()
@@ -1724,7 +1778,18 @@ def _analysis_tpu_once(model, hist, frontier: int = 256,
                     e = stop
                     dead = int(guarded_device_get(
                         prev[-2], site="offline liveness")) == 0
-                chunk_obs.observe(_time.monotonic() - t_chunk)
+                dt_chunk = _time.monotonic() - t_chunk
+                chunk_obs.observe(dt_chunk)
+                if chunk_i >= 2:
+                    # the blocking flag read is one chunk behind, so
+                    # dt_chunk measures chunk i-1: pair it with THAT
+                    # chunk's op share, and start at i>=2 so chunk 0
+                    # (which carries the compile) never enters the fit
+                    _calibrate.observe(engine_variant(decision),
+                                       cal_ops_per_entry * prev_span,
+                                       dt_chunk)
+                prev_span = stop - e0
+                chunk_i += 1
                 if dead:
                     carry = prev   # frontier died last chunk: definite
                     break

@@ -489,6 +489,84 @@ def serve_cmd() -> dict:
     }}
 
 
+def _service_status(addr: str) -> int:
+    """`jepsen-tpu service status ADDR`: query a running service's
+    `status` socket verb and pretty-print per-stream state, ladder
+    tier, budget capacity, and calibration coefficients."""
+    import json as _json
+
+    from . import service as _service
+    try:
+        sock = _service._connect(addr)
+    except OSError as e:
+        print(f"service {addr}: unreachable ({e})", file=sys.stderr)
+        return 1
+    try:
+        sock.sendall(b'{"type": "status", "id": 1}\n')
+        with sock.makefile("r", encoding="utf-8") as rf:
+            line = rf.readline()
+    finally:
+        sock.close()
+    try:
+        st = (_json.loads(line) or {}).get("status") or {}
+    except ValueError:
+        print(f"service {addr}: bad reply {line!r}", file=sys.stderr)
+        return 1
+    print(f"service {st.get('state', '?')}, "
+          f"uptime {st.get('uptime_s', 0):g}s, "
+          f"{st.get('admitted-total', 0)} admitted, "
+          f"{st.get('refused-total', 0)} refused")
+    streams = st.get("streams") or {}
+    if streams:
+        print("streams:")
+    for name in sorted(streams):
+        s = streams[name]
+        extra = ""
+        if s.get("violation"):
+            extra += "  VIOLATION"
+        if s.get("suspicion"):
+            extra += f"  suspicion={s['suspicion']:g}"
+        if s.get("shed-reason"):
+            extra += f"  shed: {s['shed-reason']}"
+        print(f"  {name:32s} state={s.get('state', '?'):10s} "
+              f"tier={s.get('ladder-tier', 'full'):24s} "
+              f"queue={s.get('queue-depth', 0):<6d} "
+              f"ops={s.get('ops-fed', 0)}{extra}")
+    b = st.get("budget") or {}
+    if b:
+        line = (f"budget: {b.get('available', 0):.3g}/"
+                f"{b.get('capacity', 0):.3g} "
+                f"{b.get('unit', 'element-ops')} "
+                f"(max {b.get('initial', 0):.3g}")
+        if b.get("ooms"):
+            line += f", {b['ooms']} ooms"
+        if b.get("cuts"):
+            line += f", {b['cuts']} cuts"
+        if b.get("p95-chunk-latency-s") is not None:
+            line += f", p95 {b['p95-chunk-latency-s']:.3g}s"
+        print(line + ")")
+    lad = st.get("ladder") or {}
+    tiers = lad.get("tiers") or {}
+    if lad:
+        parts = [f"{n} {t}" for t, n in tiers.items() if n]
+        print(f"ladder: {', '.join(parts) if parts else 'no streams'}"
+              f"; {lad.get('transitions', 0)} transitions"
+              + ("" if lad.get("adaptive", True)
+                 else " (static budget)"))
+    cal = st.get("calibration") or {}
+    coeffs = cal.get("coefficients") or {}
+    if coeffs:
+        parts = [f"{v} {c['seconds-per-elementop']:.3g} s/elementop "
+                 f"(n={c['observations']})"
+                 for v, c in sorted(coeffs.items())]
+        print(f"calibration ({cal.get('platform', '?')}): "
+              + ", ".join(parts))
+    else:
+        print(f"calibration ({cal.get('platform', '?')}): cold "
+              "(modeled element-op pricing)")
+    return 0
+
+
 def service_cmd() -> dict:
     """The persistent-verification-service command: a daemon that
     accepts live journal streams from many concurrent runs over a
@@ -498,12 +576,30 @@ def service_cmd() -> dict:
     every stream's carry is checkpointed and a restarted service
     resumes from the manifests."""
     def run_service(options):
-        from . import service as _service
+        from . import calibrate as _calibrate, service as _service
+        action = list(options.get("action") or [])
+        if action:
+            if action[0] != "status" or len(action) != 2:
+                print("usage: jepsen-tpu service status ADDR",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            raise SystemExit(_service_status(action[1]))
+        # the measured cost model: persisted next to the compile
+        # cache, loaded at start, saved back at drain — a restarted
+        # fleet prices work in measured device-seconds from its
+        # first chunk (jepsen_tpu/calibrate.py)
+        cal = _calibrate.Calibration.load()
+        if cal.coefficients():
+            log.info("calibration loaded: %s", cal.coefficients())
+        _calibrate.activate(cal)
         svc = _service.VerificationService(
             max_streams=options.get("max_streams", 64),
             budget_elementops=float(
                 options.get("budget_elementops") or
-                _service.DEFAULT_BUDGET_ELEMENTOPS))
+                _service.DEFAULT_BUDGET_ELEMENTOPS),
+            calibration=cal,
+            adaptive=not options.get("static_budget"))
+        svc.calibration_path = _calibrate.default_path(cal.platform)
         bound = svc.serve(options.get("bind") or "127.0.0.1:0")
         msrv = None
         if options.get("metrics_port") is not None:
@@ -532,6 +628,10 @@ def service_cmd() -> dict:
 
     return {"service": {
         "opt_spec": [
+            opt("action", nargs="*", metavar="ACTION",
+                help="Optional subaction: `status ADDR` queries a "
+                     "running service and pretty-prints per-stream "
+                     "state, ladder tier, budget, and calibration."),
             opt("--bind", "-b", default="127.0.0.1:0", metavar="ADDR",
                 help="host:port (port 0 picks a free port) or a unix "
                      "socket path to listen on"),
@@ -543,8 +643,15 @@ def service_cmd() -> dict:
                 help="Admission cap on concurrently attached runs."),
             opt("--budget-elementops", type=float, default=None,
                 metavar="N",
-                help="Global in-flight chunk budget in cost-model "
-                     "element-ops (OOM faults halve it at runtime)."),
+                help="Global in-flight chunk budget, expressed in "
+                     "cost-model element-ops and priced into device-"
+                     "seconds through the calibration (AIMD-tuned at "
+                     "runtime unless --static-budget)."),
+            opt("--static-budget", action="store_true",
+                help="Disable the adaptive controller: no AIMD "
+                     "capacity tuning and no degradation ladder (OOM "
+                     "halving/restore still applies). The bench A/B "
+                     "lever."),
             opt("--metrics-port", type=int, default=None, metavar="P",
                 help="Serve Prometheus metrics at :P/metrics and the "
                      "service status() JSON at :P/healthz (port 0 "

@@ -509,7 +509,8 @@ def run(test: dict) -> dict:
                     if streamed:
                         done["streamed-results"] = streamed
                         finished = sorted(set(streamed)
-                                          - {"degraded", "error"})
+                                          - {"degraded", "error",
+                                             "ladder"})
                         if streamed.get("degraded"):
                             # targets WITH a streamed verdict keep it;
                             # the crash cost the ones without, and the

@@ -81,11 +81,26 @@ def telemetry_line(results: dict) -> str:
             retries += int(rec.get("retries", 0) or 0)
             corrupt += sum(1 for k in rec.get("faults", [])
                            if k == "corrupt")
-    if not (chunks or escalated or retries or corrupt):
+    # degradation-ladder stamps (service verdicts; tier-full streams
+    # carry none — older stored results never do)
+    ladder = r.get("ladder") if isinstance(r.get("ladder"), dict) \
+        else None
+    deferred = sum(1 for s in subs if s.get("deferred")
+                   and s.get("ladder-tier"))
+    if not (chunks or escalated or retries or corrupt or ladder
+            or deferred):
         return ""
-    return (f"telemetry: {chunks} device chunks, {escalated} "
+    line = (f"telemetry: {chunks} device chunks, {escalated} "
             f"escalated, {retries} recovery retries, {corrupt} "
             f"attest failures")
+    if ladder:
+        line += (f"; ladder tier {ladder.get('tier', '?')} "
+                 f"(max {ladder.get('max-tier', '?')}, "
+                 f"{ladder.get('transitions', 0)} transitions)")
+    if deferred:
+        line += (f"; {deferred} device verdict"
+                 f"{'s' if deferred != 1 else ''} deferred to offline")
+    return line
 
 
 def service_line(status: dict) -> str:
@@ -103,12 +118,26 @@ def service_line(status: dict) -> str:
     parts = [f"{n} {state}" for state, n in sorted(by_state.items())]
     line = (f"service {st.get('state', '?')}: "
             f"{', '.join(parts) if parts else 'no streams'}")
+    # degraded-tier streams (adaptive overload control; older
+    # services' status dicts carry no ladder-tier fields)
+    degraded = sum(1 for s in streams.values()
+                   if s.get("ladder-tier") not in (None, "full"))
+    if degraded:
+        line += f"; {degraded} ladder-degraded"
     budget = st.get("budget") or {}
     if budget.get("initial"):
         line += (f"; budget {budget.get('capacity', 0):.3g}/"
                  f"{budget['initial']:.3g}")
+        events = []
         if budget.get("ooms"):
-            line += f" ({budget['ooms']} OOM backpressure events)"
+            events.append(f"{budget['ooms']} OOM backpressure events")
+        if budget.get("cuts"):
+            events.append(f"{budget['cuts']} AIMD cuts")
+        if events:
+            line += f" ({', '.join(events)})"
+    ladder = st.get("ladder") or {}
+    if ladder.get("transitions"):
+        line += f"; {ladder['transitions']} ladder transitions"
     return line
 
 

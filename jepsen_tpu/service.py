@@ -73,9 +73,11 @@ import socket as _socket
 import threading
 import time as _time
 import traceback
+import zlib
+from collections import deque
 from typing import Callable
 
-from . import store, telemetry as _telemetry
+from . import calibrate as _calibrate, store, telemetry as _telemetry
 
 log = logging.getLogger(__name__)
 
@@ -95,15 +97,30 @@ _M_OPS = _telemetry.counter(
     "jepsen_tpu_service_ops_total",
     "Journal ops fed into stream workers")
 _M_BUDGET_CAP = _telemetry.gauge(
-    "jepsen_tpu_service_budget_capacity_elementops",
-    "ChunkBudget capacity (halved by OOM backpressure, restored "
-    "gradually)")
+    "jepsen_tpu_service_budget_capacity_seconds",
+    "ChunkBudget capacity in priced device-seconds (AIMD: cut "
+    "multiplicatively on OOM/latency blowout, restored additively)")
 _M_BUDGET_AVAIL = _telemetry.gauge(
-    "jepsen_tpu_service_budget_available_elementops",
-    "ChunkBudget element-ops currently available")
+    "jepsen_tpu_service_budget_available_seconds",
+    "ChunkBudget device-seconds currently available")
 _M_OOMS = _telemetry.counter(
     "jepsen_tpu_service_budget_ooms_total",
     "OOM backpressure events that halved the global budget")
+_M_CUTS = _telemetry.counter(
+    "jepsen_tpu_service_budget_cuts_total",
+    "AIMD multiplicative capacity cuts by triggering signal",
+    ("signal",))
+_M_PRIO = _telemetry.counter(
+    "jepsen_tpu_service_priority_grants_total",
+    "Budget grants by scheduling priority class (suspect streams "
+    "acquire ahead of clean ones under contention)", ("priority",))
+_M_LADDER = _telemetry.counter(
+    "jepsen_tpu_service_ladder_transitions_total",
+    "Degradation-ladder transitions by direction and destination tier",
+    ("direction", "tier"))
+_M_TIER = _telemetry.gauge(
+    "jepsen_tpu_service_ladder_streams",
+    "Live streams per degradation-ladder tier", ("tier",))
 _M_VERB = _telemetry.histogram(
     "jepsen_tpu_service_verb_seconds",
     "Socket verb handling latency", ("verb",))
@@ -120,15 +137,76 @@ SHED = "shed"
 DRAINED = "drained"
 VERDICT = "verdict"
 
+# degradation-ladder tiers (doc/robustness.md: the overload ladder).
+# Orthogonal to the lifecycle states above: a streaming stream sits at
+# exactly one tier; climbing trades verification depth for device time
+# and never loses a definite violation (screens keep running at every
+# tier, and a suspect stream descends to full immediately).
+TIER_FULL = 0           # all targets pump normally
+TIER_SAMPLED = 1        # device chunks only for suspect/sampled streams
+TIER_SCREEN = 2         # O(n) screens only; device verdict deferred
+TIER_SHED = 3           # shed-to-offline (the pre-existing last rung)
+TIER_NAMES = ("full", "sampled-escalation-only", "screen-only", "shed")
+
 DEFAULT_MAX_STREAMS = 64
 DEFAULT_QUEUE_OPS = 50_000
 DEFAULT_SHED_TIMEOUT_S = 2.0
 # global in-flight device budget, in select_engine-modeled element-ops
 # (~a dozen default-shape sort chunks); acquire clamps to capacity so
-# a single over-budget chunk always eventually dispatches
+# a single over-budget chunk always eventually dispatches. The budget
+# itself runs in priced device-seconds — element-ops convert through
+# the calibration (measured coefficients when known, the nominal
+# constant otherwise, so uncalibrated scheduling is unchanged: costs
+# and capacity scale by the same constant).
 DEFAULT_BUDGET_ELEMENTOPS = 1e9
-# budget restoration per clean chunk, as a fraction of the shortfall
-BUDGET_RESTORE_FRACTION = 0.05
+# -- AIMD budget constants (doc/robustness.md documents the policy) --
+BUDGET_FLOOR_FRACTION = 1 / 64.0    # capacity never cut below this
+BUDGET_RESTORE_STEP = 0.02      # additive restore per clean chunk,
+#                                 as a fraction of max capacity
+BUDGET_HYSTERESIS_S = 5.0       # after a cut: no restore, and no
+#                                 further latency cut, for this long
+BUDGET_BLOWOUT_P95_S = 5.0      # p95 chunk latency that cuts capacity
+BUDGET_RESTORE_SLOW_FRACTION = 0.5  # clean chunks between the
+#                                 low-latency bar and this fraction of
+#                                 blowout restore at HALF step — slow
+#                                 re-open beats permanent halving
+BUDGET_RESTORE_LATENCY_FRACTION = 0.25  # "low-latency" chunk bar for
+#                                 restore, as a fraction of blowout
+BUDGET_AGING_S = 2.0            # a waiter older than this reserves
+#                                 capacity (cheap chunks stop bypassing)
+BUDGET_LATENCY_WINDOW = 64      # rolling chunk-latency window for p95
+BUDGET_HUNGRY_ROWS = 4096       # queue-depth EWMA past which clean
+#                                 chunks restore at double step
+# -- ladder controller defaults --
+LADDER_TICK_S = 0.25
+LADDER_CLIMB_HOLD_S = 2.0       # sustained overload before one climb
+LADDER_DESCEND_HOLD_S = 6.0     # sustained calm before one descend
+#                                 (descend > climb: transition hysteresis)
+# deterministic sampled-escalation fraction for ladder tier 1 (keyed
+# on the stream name, so a re-admitted run makes the same choice)
+LADDER_SAMPLE = 0.25
+# clean chunks between budget re-pricings of a stream's chunk cost —
+# the cadence at which a converging calibration reaches the scheduler
+REPRICE_EVERY_CHUNKS = 32
+
+# kernel identities whose first execution (= the jit compile for that
+# shape) some stream in this process already paid: only the ONE
+# builder stream per shape has a compile-tainted first chunk, so every
+# other stream's chunk-0 sample is a legitimate execution measurement
+_CAL_SEEN_LOCK = threading.Lock()
+_CAL_KERNELS_SEEN: set = set()      # guarded-by: _CAL_SEEN_LOCK
+
+
+def _kernel_already_run(key) -> bool:
+    """True if a stream in this process already ran this jitted
+    kernel; marks it run otherwise."""
+    with _CAL_SEEN_LOCK:
+        if key in _CAL_KERNELS_SEEN:
+            return True
+        if len(_CAL_KERNELS_SEEN) > 4096:   # id()s of a 32-entry LRU:
+            _CAL_KERNELS_SEEN.clear()       # bounded churn, cheap reset
+        _CAL_KERNELS_SEEN.add(key)
+        return False
 
 _SEAL = object()
 _CLOSE = object()
@@ -282,94 +360,265 @@ def build_targets(spec: dict, stream_name: str = "",
 # the global chunk budget (cost-model scheduling + OOM backpressure)
 # ---------------------------------------------------------------------------
 
-class ChunkBudget:
-    """A weighted semaphore over `wgl.select_engine`-modeled
-    element-ops: each stream acquires its chunk's modeled cost before
-    dispatching. Cheap chunks interleave many-at-a-time; an expensive
-    stream serializes against the budget instead of monopolizing the
-    device. An OOM anywhere halves capacity (backpressure for the
-    whole service); clean chunks restore it gradually."""
+class _Waiter:
+    """One blocked acquirer; entitlement orders grants (priority
+    first, FIFO within a priority class)."""
 
-    def __init__(self, capacity: float = DEFAULT_BUDGET_ELEMENTOPS):
-        self.initial = float(capacity)
+    __slots__ = ("priority", "seq", "need", "t0")
+
+    def __init__(self, priority: int, seq: int, need: float,
+                 t0: float):
+        self.priority = priority
+        self.seq = seq
+        self.need = need
+        self.t0 = t0
+
+    def entitlement(self) -> tuple:
+        return (self.priority, -self.seq)
+
+
+class ChunkBudget:
+    """A self-tuning weighted semaphore over priced device-seconds:
+    each stream acquires its chunk's cost (modeled element-ops priced
+    through the calibration, see `chunk_cost`) before dispatching.
+    Cheap chunks interleave many-at-a-time; an expensive stream
+    serializes against the budget instead of monopolizing the device.
+
+    **AIMD capacity.** An OOM anywhere halves capacity immediately
+    (safety first — no hysteresis on memory pressure); a p95
+    chunk-latency blowout halves it too (at most once per
+    ``hysteresis_s``). Clean low-latency chunks restore capacity
+    *additively* (``restore_step`` of max per chunk; doubled while
+    queues run deep — an over-cut hungry system re-opens faster), but
+    never within ``hysteresis_s`` of a cut and never past the
+    configured max. The floor clamp keeps one chunk always
+    dispatchable. ``adaptive=False`` freezes capacity except for the
+    pre-existing OOM halving/restore (the bench A/B lever).
+
+    **Priority.** ``acquire(priority=1)`` (suspect streams) grants
+    ahead of priority 0 under contention. Grants are work-conserving:
+    a cheap waiter may bypass a more-entitled one whose cost does not
+    fit *yet* — until that waiter has aged past ``aging_s``, at which
+    point capacity is reserved for it (no bypass starvation in either
+    direction; pinned by tests/test_adaptive.py)."""
+
+    def __init__(self, capacity: float = DEFAULT_BUDGET_ELEMENTOPS
+                 * _calibrate.NOMINAL_SECONDS_PER_ELEMENTOP,
+                 *, adaptive: bool = True,
+                 blowout_s: float = BUDGET_BLOWOUT_P95_S,
+                 hysteresis_s: float = BUDGET_HYSTERESIS_S,
+                 restore_step: float = BUDGET_RESTORE_STEP,
+                 aging_s: float = BUDGET_AGING_S):
+        self.initial = float(capacity)      # the AIMD ceiling
+        self.floor = self.initial * BUDGET_FLOOR_FRACTION
+        self.adaptive = bool(adaptive)
+        self.blowout_s = float(blowout_s)
+        self.hysteresis_s = float(hysteresis_s)
+        self.restore_step = float(restore_step)
+        self.aging_s = float(aging_s)
         self.capacity = float(capacity)     # guarded-by: _cv
-        self._avail = float(capacity)       # guarded-by: _cv
+        # outstanding granted cost: availability is DERIVED as
+        # capacity - _out, so AIMD capacity moves (cuts and restores)
+        # are spendable immediately — a stored available-pool would
+        # conserve the post-cut pool and never see the restore
+        self._out = 0.0                     # guarded-by: _cv
         self._cv = threading.Condition()
         self.ooms = 0                       # guarded-by: _cv
+        self.cuts = 0                       # guarded-by: _cv
+        self._waiters: list = []            # guarded-by: _cv
+        self._seq = 0                       # guarded-by: _cv
+        self._lat: deque = deque(
+            maxlen=BUDGET_LATENCY_WINDOW)   # guarded-by: _cv
+        self._last_cut = float("-inf")      # guarded-by: _cv
+        self._qdepth = 0.0                  # guarded-by: _cv
         _M_BUDGET_CAP.set(self.capacity)
-        _M_BUDGET_AVAIL.set(self._avail)
+        _M_BUDGET_AVAIL.set(self.capacity)
+
+    def _avail_locked(self) -> float:  # holds: _cv
+        """Spendable device-seconds; negative while an over-capacity
+        chunk is in flight or after a cut undercuts outstanding work."""
+        return self.capacity - self._out
+
+    def _grantable(self, w: _Waiter, now: float) -> bool:  # holds: _cv
+        avail = self._avail_locked()
+        # relative tolerance: the ledger accumulates float residue the
+        # old clamped pool absorbed, and ~1e-16 of leftover _out must
+        # not block a waiter needing exactly the full capacity
+        eps = 1e-12 * (self.capacity + self._out)
+        if avail < min(w.need, self.capacity) - eps:
+            return False
+        w_aged = now - w.t0 > self.aging_s
+        for o in self._waiters:
+            if o is w:
+                continue
+            o_aged = now - o.t0 > self.aging_s
+            if o.entitlement() > w.entitlement() \
+                    and avail >= min(o.need, self.capacity) - eps:
+                return False    # a more-entitled waiter fits: it first
+            if o_aged and (not w_aged or (o.t0, o.seq)
+                           < (w.t0, w.seq)):
+                # an aged waiter reserves capacity against EVERY
+                # younger arrival, suspects included — otherwise a
+                # steady suspect load starves a clean stream forever;
+                # aged waiters drain among themselves in ARRIVAL
+                # order (a strict total order, so no two aged waiters
+                # ever block each other), which bounds every class's
+                # wait instead of re-starving the less entitled
+                return False
+        return True
 
     def acquire(self, cost: float, timeout_s: float | None = None,
-                cancel: Callable[[], bool] | None = None) -> bool:
-        cost = max(float(cost), 1.0)
+                cancel: Callable[[], bool] | None = None,
+                priority: int = 0) -> bool:
+        cost = max(float(cost), 1e-9)
         deadline = (None if timeout_s is None
                     else _time.monotonic() + timeout_s)
         with self._cv:
-            while self._avail < min(cost, self.capacity):
-                if cancel is not None and cancel():
-                    return False
-                wait = 0.1
-                if deadline is not None:
-                    wait = min(wait, deadline - _time.monotonic())
-                    if wait <= 0:
+            self._seq += 1
+            w = _Waiter(int(priority), self._seq, cost,
+                        _time.monotonic())
+            self._waiters.append(w)
+            try:
+                while not self._grantable(w, _time.monotonic()):
+                    if cancel is not None and cancel():
                         return False
-                self._cv.wait(wait)
-            self._avail -= min(cost, self.capacity)
-            _M_BUDGET_AVAIL.set(self._avail)
-            return True
+                    wait = 0.1
+                    if deadline is not None:
+                        wait = min(wait, deadline - _time.monotonic())
+                        if wait <= 0:
+                            return False
+                    self._cv.wait(wait)
+                self._out += cost
+                _M_BUDGET_AVAIL.set(max(0.0, self._avail_locked()))
+                _M_PRIO.labels(priority=str(int(priority))).inc()
+                return True
+            finally:
+                self._waiters.remove(w)
+                # a grant/give-up can unblock a DIFFERENT waiter (the
+                # entitlement head just left): re-check promptly
+                self._cv.notify_all()
 
-    def release(self, cost: float, clean: bool = True) -> None:
-        cost = max(float(cost), 1.0)
+    def release(self, cost: float, clean: bool = True,
+                seconds: float | None = None) -> None:
+        """Return a chunk's cost; `seconds` is its observed device
+        latency — the AIMD restore/cut signal."""
+        cost = max(float(cost), 1e-9)
         with self._cv:
-            if clean and self.capacity < self.initial:
-                self.capacity = min(
-                    self.initial,
-                    self.capacity + BUDGET_RESTORE_FRACTION
-                    * (self.initial - self.capacity))
-            self._avail = min(self.capacity,
-                              self._avail + min(cost, self.capacity))
+            now = _time.monotonic()
+            if seconds is not None:
+                self._lat.append(float(seconds))
+                if self.adaptive and len(self._lat) >= 8 \
+                        and now - self._last_cut >= self.hysteresis_s:
+                    p95 = self._p95_locked()
+                    if p95 is not None and p95 > self.blowout_s:
+                        self._cut_locked("latency", now)
+            low_latency = seconds is None or seconds <= \
+                self.blowout_s * BUDGET_RESTORE_LATENCY_FRACTION
+            mid_latency = seconds is not None and not low_latency \
+                and seconds <= self.blowout_s * \
+                BUDGET_RESTORE_SLOW_FRACTION
+            if clean and (low_latency or mid_latency) \
+                    and self.capacity < self.initial \
+                    and now - self._last_cut >= self.hysteresis_s:
+                step = self.initial * self.restore_step
+                if mid_latency:
+                    step *= 0.5   # healthy-but-unhurried chunks
+                    #               (between the bars) re-open slowly:
+                    #               a fleet whose normal latency sits
+                    #               there must not stay halved forever
+                elif self._qdepth > BUDGET_HUNGRY_ROWS:
+                    step *= 2   # deep queues + clean fast chunks:
+                    #             the cut overshot, re-open faster
+                self.capacity = min(self.initial,
+                                    self.capacity + step)
+            self._out = max(0.0, self._out - cost)
             _M_BUDGET_CAP.set(self.capacity)
-            _M_BUDGET_AVAIL.set(self._avail)
+            _M_BUDGET_AVAIL.set(max(0.0, self._avail_locked()))
             self._cv.notify_all()
+
+    def _cut_locked(self, signal: str, now: float) -> None:  # holds: _cv
+        self.capacity = max(self.floor, self.capacity / 2)
+        self._last_cut = now
+        self.cuts += 1
+        _M_CUTS.labels(signal=signal).inc()
+        _M_BUDGET_CAP.set(self.capacity)
+        _M_BUDGET_AVAIL.set(max(0.0, self._avail_locked()))
 
     def note_oom(self) -> None:
+        """Memory pressure cuts immediately, hysteresis or not — the
+        alternative is the backend OOM-killing every stream."""
         with self._cv:
             self.ooms += 1
-            self.capacity = max(self.initial / 64.0, self.capacity / 2)
-            self._avail = min(self._avail, self.capacity)
+            self._cut_locked("oom", _time.monotonic())
             _M_OOMS.inc()
-            _M_BUDGET_CAP.set(self.capacity)
-            _M_BUDGET_AVAIL.set(self._avail)
             self._cv.notify_all()
+
+    def note_queue_depth(self, rows: int) -> None:
+        with self._cv:
+            self._qdepth = 0.9 * self._qdepth + 0.1 * float(rows)
+
+    def _p95_locked(self) -> float | None:  # holds: _cv
+        if not self._lat:
+            return None
+        lat = sorted(self._lat)
+        return lat[int(0.95 * (len(lat) - 1))]
+
+    def signals(self) -> dict:
+        """The overload signals the ladder controller reads (one lock
+        round-trip)."""
+        with self._cv:
+            return {"waiters": len(self._waiters),
+                    "capacity": self.capacity,
+                    "initial": self.initial,
+                    "available": max(0.0, self._avail_locked()),
+                    "p95_latency_s": self._p95_locked(),
+                    "queue_depth_ewma": self._qdepth,
+                    "recent_cut": (_time.monotonic() - self._last_cut
+                                   < self.hysteresis_s)}
 
     def status(self) -> dict:
         with self._cv:
-            return {"initial": self.initial,
+            return {"unit": "device-seconds",
+                    "initial": self.initial,
                     "capacity": self.capacity,
-                    "available": self._avail,
-                    "ooms": self.ooms}
+                    "available": max(0.0, self._avail_locked()),
+                    "floor": self.floor,
+                    "adaptive": self.adaptive,
+                    "ooms": self.ooms,
+                    "cuts": self.cuts,
+                    "waiters": len(self._waiters),
+                    "p95-chunk-latency-s": self._p95_locked(),
+                    "queue-depth-ewma": round(self._qdepth, 1)}
 
 
-def chunk_cost(stream) -> tuple[float, str]:
-    """One chunk's modeled element-ops for a WGL stream, priced
-    through `wgl.select_engine` at the stream's actual kernel shape.
-    (cost, reason) — the reason surfaces in status()."""
+@dataclasses.dataclass
+class ChunkPrice:
+    """One chunk's price for a WGL stream: modeled element-ops from
+    `wgl.select_engine` at the stream's actual kernel shape, priced
+    into device-seconds through the calibration."""
+    cost: float         # device-seconds (budget units)
+    elementops: float   # modeled element-ops for one chunk
+    variant: str        # dense | sort | hash | unpriced
+    reason: str
+
+
+def chunk_cost(stream, calibration=None) -> ChunkPrice:
     from .checker import wgl
     srange = stream.state_range or (0, 3)   # undeclared: nominal S=4
     try:
+        eng = stream.engine if stream.engine in ("dense", "sort") \
+            else "auto"
         dec = wgl.select_engine(tuple(srange), stream.p, stream.chunk,
                                 slots=stream.p,
                                 frontier=stream.frontier,
-                                pallas=stream.pallas)
-        if stream.engine == "dense":
-            cost = dec.costs["dense"]
-        elif dec.dedup == wgl.DEDUP_PALLAS:
-            cost = dec.costs["hash"]
-        else:
-            cost = dec.costs["sort"]
-        return float(cost), dec.reason
+                                pallas=stream.pallas, engine=eng,
+                                calibration=calibration)
+        ops = wgl.engine_cost(dec)
+        variant, reason = wgl.engine_variant(dec), dec.reason
     except Exception:  # noqa: BLE001 — pricing is advisory
-        return 1e6, "unpriced"
+        ops, variant, reason = 1e6, "unpriced", "unpriced"
+    return ChunkPrice(_calibrate.price(calibration, variant, ops),
+                      ops, variant, reason)
 
 
 # ---------------------------------------------------------------------------
@@ -408,12 +657,119 @@ class StreamWorker:
         self.shed_reason: str | None = None
         self._drain = threading.Event()
         self._dead_targets: set[str] = set()
-        self._costs = {n: chunk_cost(t)
+        self._costs = {n: chunk_cost(t, service.calibration)
                        for n, t in self.targets.items()
                        if hasattr(t, "pending_chunks")}
+        # -- degradation-ladder / suspicion-priority scheduling state.
+        # Written by the worker thread AND the service ladder thread,
+        # read by status()/socket threads — all through the methods
+        # below, under _tier_lock.
+        self._tier_lock = threading.Lock()
+        self.tier = TIER_FULL               # guarded-by: _tier_lock
+        self.max_tier = TIER_FULL           # guarded-by: _tier_lock
+        self.tier_transitions = 0           # guarded-by: _tier_lock
+        self.suspicion_score = 0.0          # guarded-by: _tier_lock
+        from .checker import screen as _screen
+        # deterministic per-stream sample for the sampled-escalation
+        # tier (same Knuth hash the tier-1 audit sampling uses)
+        self._sampled = _screen.sample_decision(
+            zlib.crc32(name.encode()), LADDER_SAMPLE)
+        # a stream is *suspect* at the tier-1 escalation bar, not at
+        # any nonzero suspicion: soft signals (crashed mutators, 0.02
+        # each capped 0.5) ride nearly every realistic history — below
+        # the bar they must neither outrank siblings nor pin a stream
+        # to tier-full, or priority and the ladder both degenerate
+        self._suspect_bar = _screen.ESCALATE_THRESHOLD
+        self._pumped = 0    # clean chunks pumped (worker thread only)
+        # per-target chunks pumped IN THIS PROCESS (worker thread
+        # only) — `t._chunks` survives a checkpoint resume, so it
+        # cannot tell a restarted daemon's compile-paying first chunk
+        # from a warm one
+        self._pumped_by: dict[str, int] = {}
+        # targets whose chunk 0 compiled the kernel: their lagged
+        # warm==1 latency sample is compile, not execution
+        # (worker thread only)
+        self._cal_skip: set = set()
         self.thread = threading.Thread(
             target=self._run, name=f"jepsen-service-{name}",
             daemon=True)
+
+    # -- degradation ladder + suspicion-priority metadata --------------------
+
+    def current_tier(self) -> int:
+        with self._tier_lock:
+            return self.tier
+
+    def set_tier(self, tier: int, why: str) -> bool:
+        """One ladder transition (idempotent). Climbing to TIER_SHED
+        sheds the stream (the pre-existing terminal rung)."""
+        with self._tier_lock:
+            old = self.tier
+            if tier == old:
+                return False
+            self.tier = tier
+            self.max_tier = max(self.max_tier, tier)
+            self.tier_transitions += 1
+        with self.service._lock:
+            self.service.ladder_transitions_total += 1
+        direction = "climb" if tier > old else "descend"
+        _M_LADDER.labels(direction=direction,
+                         tier=TIER_NAMES[tier]).inc()
+        log.log(logging.WARNING if tier > old else logging.INFO,
+                "service %s: ladder %s %s -> %s (%s)", self.name,
+                direction, TIER_NAMES[old], TIER_NAMES[tier], why)
+        if tier == TIER_SHED:
+            self.shed(f"degradation ladder: {why}")
+        return True
+
+    def refresh_suspicion(self) -> float:
+        """Pull the targets' live suspicion into the scheduling
+        metadata. A stream that turns suspect is prioritized for
+        device time and — safety beats hysteresis — descends to
+        tier-full immediately."""
+        targets = self.targets   # snapshot: _release_targets swaps it
+        s = 0.0
+        for n, t in targets.items():
+            if n in self._dead_targets:
+                continue
+            if getattr(t, "violation", False):
+                s = max(s, 1.0)
+            try:
+                s = max(s, float(getattr(t, "suspicion", 0.0) or 0.0))
+            except (TypeError, ValueError):
+                pass
+        with self._tier_lock:
+            was, self.suspicion_score = self.suspicion_score, s
+        suspect = s >= self._suspect_bar
+        if suspect and was < self._suspect_bar:
+            _M_EVENTS.labels(event="prioritized").inc()
+        if suspect and self.current_tier() in (TIER_SAMPLED,
+                                               TIER_SCREEN):
+            self.set_tier(TIER_FULL, "suspicion")
+        return s
+
+    def scheduling_priority(self) -> int:
+        with self._tier_lock:
+            return 1 if self.suspicion_score >= self._suspect_bar \
+                else 0
+
+    def device_cost(self) -> float:
+        """This stream's priced per-chunk device cost — the ladder
+        climbs the most expensive clean stream first (shedding a cheap
+        screen-heavy stream frees almost nothing)."""
+        return sum(p.cost for p in self._costs.values())
+
+    def _device_allowed(self) -> bool:
+        """May this stream's device (WGL) targets dispatch chunks at
+        its current tier? Screens always run — they are fed, not
+        pumped."""
+        with self._tier_lock:
+            tier, susp = self.tier, self.suspicion_score
+        if tier == TIER_FULL:
+            return True
+        if tier == TIER_SAMPLED:
+            return susp >= self._suspect_bar or self._sampled
+        return False
 
     def _terminal(self, event: str) -> None:
         """Mark the worker done, counting the terminal lifecycle event
@@ -463,7 +819,7 @@ class StreamWorker:
         out = dict(self._final_chunks)
         for name, t in self.targets.items():
             if hasattr(t, "pending_chunks"):
-                cost, why = self._costs.get(name, (None, ""))
+                price = self._costs.get(name)
                 out[name] = {
                     "dispatched": getattr(t, "_chunks", 0),
                     "pending": (t.pending_chunks()
@@ -472,8 +828,11 @@ class StreamWorker:
                     "chunk-syncs": getattr(t, "_chunk_syncs", 0),
                     "resumed-from-chunk": getattr(
                         t, "_resumed_from_chunk", None),
-                    "cost-per-chunk": cost,
-                    "engine-reason": why,
+                    "cost-per-chunk": price.cost if price else None,
+                    "elementops-per-chunk": (price.elementops
+                                             if price else None),
+                    "variant": price.variant if price else None,
+                    "engine-reason": price.reason if price else "",
                 }
         return out
 
@@ -510,6 +869,7 @@ class StreamWorker:
                     break
             if fed:
                 _M_OPS.inc(fed)   # one batched inc per drain burst
+            self.refresh_suspicion()
             self._pump()
             self._note_violation()
             if sealed and self.q.empty():
@@ -549,25 +909,40 @@ class StreamWorker:
     def _pump(self) -> None:
         """Dispatch pending chunks under the global budget — the
         cost-model scheduling point. One chunk per acquire, so other
-        streams' acquires interleave between our chunks."""
+        streams' acquires interleave between our chunks. Suspicion
+        sets the acquire priority; the degradation ladder gates
+        whether device chunks dispatch at all (screens are fed, not
+        pumped — they run at every tier)."""
         _M_QUEUE.observe(self.q.qsize())
+        self.service.budget.note_queue_depth(self.q.qsize())
         for name, t in self.targets.items():
             if name in self._dead_targets \
                     or not hasattr(t, "pending_chunks"):
                 continue
             while t.pending_chunks() > 0 and not self._drain.is_set():
-                cost, _why = self._costs.get(name, (1e6, ""))
+                if not self._device_allowed():
+                    # deferred by the ladder; chunks stay pending (a
+                    # descend or finish-time suspicion re-opens them)
+                    return
+                price = self._costs.get(name)
+                if price is None:
+                    price = self._costs[name] = \
+                        chunk_cost(t, self.service.calibration)
                 if not self.service.budget.acquire(
-                        cost, timeout_s=5.0,
-                        cancel=self._drain.is_set):
+                        price.cost, timeout_s=5.0,
+                        cancel=self._drain.is_set,
+                        priority=self.scheduling_priority()):
                     break
                 n0 = len(t.faults)
                 clean = True
+                t0 = _time.monotonic()
                 try:
                     t.pump(1)
                 except Exception:  # noqa: BLE001 — unclassified
-                    self.service.budget.release(cost, clean=False)
+                    self.service.budget.release(price.cost,
+                                                clean=False)
                     raise
+                dt = _time.monotonic() - t0
                 new = t.faults[n0:]
                 if new:
                     clean = False
@@ -577,15 +952,67 @@ class StreamWorker:
                         self.service.budget.note_oom()
                     # the stream re-priced itself (OOM halves its
                     # chunk, compile drops pallas): re-price the chunk
-                    self._costs[name] = chunk_cost(t)
-                self.service.budget.release(cost, clean=clean)
+                    self._costs[name] = chunk_cost(
+                        t, self.service.calibration)
+                else:
+                    # feed the measured cost model. The stream's
+                    # liveness sync lags one chunk, so pump k's dt
+                    # measures chunk k-1: pump 0 (blocks on the init
+                    # carry, measures nothing) never feeds, pump 1
+                    # (measures chunk 0) feeds unless THIS stream's
+                    # chunk 0 paid the shape's jit compile, and
+                    # unpriced targets never feed
+                    self._pumped += 1
+                    warm = self._pumped_by.get(name, 0)
+                    self._pumped_by[name] = warm + 1
+                    if warm == 0:
+                        kk = getattr(t, "kernel_key", lambda: None)()
+                        if kk is not None and \
+                                not _kernel_already_run(kk):
+                            self._cal_skip.add(name)
+                    elif price.variant != "unpriced" and not (
+                            warm == 1 and name in self._cal_skip):
+                        self.service.calibration.observe(
+                            price.variant, price.elementops, dt)
+                    if self._pumped % REPRICE_EVERY_CHUNKS == 0:
+                        # calibration converges while we pump: re-price
+                        # so the budget charge tracks measured seconds
+                        self._costs[name] = chunk_cost(
+                            t, self.service.calibration)
+                self.service.budget.release(price.cost, clean=clean,
+                                            seconds=dt)
             if self.state == RECOVERING:
                 self.state = STREAMING
 
     def _finish(self) -> None:
+        # last suspicion pull before the verdict: a stream that turned
+        # suspect descends to tier-full (refresh_suspicion) and its
+        # pending device chunks run after all — safety beats the ladder
+        self.refresh_suspicion()
+        self._note_violation()
+        with self._tier_lock:
+            tier, max_tier = self.tier, self.max_tier
+        defer_device = not self._device_allowed()
         out: dict = {}
         for name, t in self.targets.items():
             if name in self._dead_targets:
+                continue
+            if defer_device and hasattr(t, "pending_chunks") \
+                    and t.pending_chunks() > 0:
+                # the ladder held this stream's device chunks back and
+                # nothing ever looked suspect: defer the device verdict
+                # to offline checking (no "valid?" key -> the checkers'
+                # streamed-results reuse guard skips it) instead of
+                # pumping a whole history at seal time under overload.
+                # A target with NOTHING pending finished its device
+                # work before the climb — its verdict is already paid
+                # for, so finish() keeps it
+                out[name] = {"deferred": True,
+                             "reason": f"degradation ladder: "
+                                       f"{TIER_NAMES[tier]}",
+                             "ladder-tier": TIER_NAMES[tier],
+                             "history-len": self.ops_fed}
+                _M_EVENTS.labels(event="device-verdict-deferred").inc()
                 continue
             try:
                 r = t.finish()
@@ -597,6 +1024,16 @@ class StreamWorker:
             if r is not None:
                 r.setdefault("history-len", self.ops_fed)
                 out[name] = r
+        if max_tier > TIER_FULL:
+            # stamp degraded-tier verdicts so they are distinguishable
+            # from full ones. Streams that stayed at tier-full carry NO
+            # stamp: their verdicts remain byte-identical to solo runs.
+            with self._tier_lock:
+                out["ladder"] = {
+                    "tier": TIER_NAMES[self.tier],
+                    "max-tier": TIER_NAMES[self.max_tier],
+                    "transitions": self.tier_transitions,
+                }
         self.results = out
         self.state = VERDICT
         if self.store_dir:
@@ -699,6 +1136,10 @@ class StreamWorker:
         self._terminal("shed")
 
     def status(self) -> dict:
+        with self._tier_lock:
+            tier, max_tier = self.tier, self.max_tier
+            transitions = self.tier_transitions
+            suspicion = self.suspicion_score
         st = {
             "state": self.state,
             "queue-depth": self.q.qsize(),
@@ -708,6 +1149,11 @@ class StreamWorker:
             "attest-failures": self._attest_failures(),
             "targets": self.target_names,
             "dead-targets": sorted(self._dead_targets),
+            "ladder-tier": TIER_NAMES[tier],
+            "ladder-max-tier": TIER_NAMES[max_tier],
+            "tier-transitions": transitions,
+            "suspicion": suspicion,
+            "priority": (1 if suspicion >= self._suspect_bar else 0),
         }
         chunks = self._chunk_status()
         if chunks:
@@ -731,11 +1177,36 @@ class VerificationService:
     def __init__(self, max_streams: int = DEFAULT_MAX_STREAMS,
                  queue_ops: int = DEFAULT_QUEUE_OPS,
                  shed_timeout_s: float = DEFAULT_SHED_TIMEOUT_S,
-                 budget_elementops: float = DEFAULT_BUDGET_ELEMENTOPS):
+                 budget_elementops: float = DEFAULT_BUDGET_ELEMENTOPS,
+                 calibration: "_calibrate.Calibration | None" = None,
+                 adaptive: bool = True,
+                 ladder_tick_s: float = LADDER_TICK_S,
+                 ladder_climb_hold_s: float = LADDER_CLIMB_HOLD_S,
+                 ladder_descend_hold_s: float = LADDER_DESCEND_HOLD_S):
         self.max_streams = max_streams
         self.queue_ops = queue_ops
         self.shed_timeout_s = shed_timeout_s
-        self.budget = ChunkBudget(budget_elementops)
+        # every service calibrates a private cost model from its own
+        # chunk latencies (the daemon passes the persisted one in and
+        # saves it back at drain — calibration_path); budget capacity
+        # converts through the same nominal constant the uncalibrated
+        # pricing uses, so static scheduling is unchanged
+        self.calibration = (calibration if calibration is not None
+                            else _calibrate.Calibration())
+        self.calibration_path: str | None = None
+        self.budget = ChunkBudget(
+            budget_elementops
+            * _calibrate.NOMINAL_SECONDS_PER_ELEMENTOP,
+            adaptive=adaptive)
+        self.adaptive = bool(adaptive)
+        self.ladder_tick_s = float(ladder_tick_s)
+        self.ladder_climb_hold_s = float(ladder_climb_hold_s)
+        self.ladder_descend_hold_s = float(ladder_descend_hold_s)
+        self._ladder_stop = threading.Event()
+        self._ladder_thread: threading.Thread | None = None  # guarded-by: _lock
+        # overload/calm onset timestamps (ladder thread only)
+        self._overload_t: float | None = None
+        self._calm_t: float | None = None
         self.workers: dict[str, StreamWorker] = {}  # guarded-by: _lock
         # finished workers kept (newest last) for late status/result
         # queries; older ones are reaped so a long-lived daemon's
@@ -745,6 +1216,9 @@ class VerificationService:
         self.drained = threading.Event()
         self.admitted_total = 0         # guarded-by: _lock
         self.refused_total = 0          # guarded-by: _lock
+        # monotonic across the daemon's whole life: summing per-worker
+        # counts would go BACKWARDS when finished workers are reaped
+        self.ladder_transitions_total = 0   # guarded-by: _lock
         self.t0 = _time.monotonic()
         self._lock = threading.Lock()
         self._server: _socket.socket | None = None
@@ -786,6 +1260,7 @@ class VerificationService:
             _M_EVENTS.labels(event="admitted").inc()
             _M_ACTIVE.inc()
         w.thread.start()
+        self._ensure_ladder()
         log.info("service: admitted stream %r (targets %s)", name,
                  sorted(w.targets))
         return w
@@ -830,6 +1305,104 @@ class VerificationService:
         if w is not None:
             w.shed(reason)
 
+    # -- the degradation-ladder controller ---------------------------------
+
+    def _ensure_ladder(self) -> None:
+        with self._lock:
+            if not self.adaptive or self._ladder_thread is not None:
+                return   # a second controller would double the
+                #          climb/descend rate (both mutate the hold
+                #          timers), defeating the hysteresis
+            t = threading.Thread(
+                target=self._ladder_loop, name="jepsen-service-ladder",
+                daemon=True)
+            self._ladder_thread = t
+        t.start()
+
+    def _live_workers(self) -> list:
+        with self._lock:
+            return [w for w in self.workers.values()
+                    if not w.done.is_set()]
+
+    def overloaded(self, sig: dict | None = None) -> bool:
+        """The ladder's overload predicate over the budget's signals:
+        demand visibly exceeding supply — blocked acquirers, a p95
+        chunk-latency blowout, or a hungry queue. Supply-side facts
+        alone (a recent AIMD cut, capacity still below half of max)
+        do NOT count: a lone transient OOM with nobody waiting must
+        not climb a clean stream and turn a deterministic verdict
+        into a deferred one."""
+        s = sig if sig is not None else self.budget.signals()
+        return bool(
+            s["waiters"] > 0
+            or (s["p95_latency_s"] or 0.0) > self.budget.blowout_s
+            or s["queue_depth_ewma"] > BUDGET_HUNGRY_ROWS)
+
+    def _ladder_step(self, now: float) -> None:
+        """One controller tick: refresh suspicion for idle streams,
+        climb ONE stream per sustained-overload hold, descend ONE per
+        sustained-calm hold (descend hold > climb hold = transition
+        hysteresis), and publish the per-tier stream gauge."""
+        workers = self._live_workers()
+        for w in workers:
+            w.refresh_suspicion()
+        if self.overloaded():
+            self._calm_t = None
+            if self._overload_t is None:
+                self._overload_t = now
+            elif now - self._overload_t >= self.ladder_climb_hold_s:
+                if self._climb_one(workers):
+                    self._overload_t = now  # one climb per hold
+        else:
+            self._overload_t = None
+            if self._calm_t is None:
+                self._calm_t = now
+            elif now - self._calm_t >= self.ladder_descend_hold_s:
+                if self._descend_one(workers):
+                    self._calm_t = now      # one descend per hold
+        counts = dict.fromkeys(TIER_NAMES, 0)
+        for w in workers:
+            counts[TIER_NAMES[w.current_tier()]] += 1
+        for tname, c in counts.items():
+            _M_TIER.labels(tier=tname).set(c)
+
+    def _climb_one(self, workers: list) -> bool:
+        """Climb ONE clean stream one rung: lowest tier first (spread
+        the pain — no stream rides to shed while siblings sit at
+        full), most expensive within a tier (climbing a cheap stream
+        frees almost nothing). Suspect streams are never climbed —
+        under contention they are exactly the ones that must keep
+        device time."""
+        eligible = [w for w in workers
+                    if w.scheduling_priority() == 0
+                    and w.current_tier() < TIER_SHED
+                    and w._costs]   # streams with device targets only
+        if not eligible:
+            return False
+        w = min(eligible,
+                key=lambda w: (w.current_tier(), -w.device_cost()))
+        return w.set_tier(w.current_tier() + 1, "sustained overload")
+
+    def _descend_one(self, workers: list) -> bool:
+        """Descend ONE degraded stream one rung: most degraded first,
+        cheapest within a tier (it re-opens the least device load if
+        the calm is a blip)."""
+        eligible = [w for w in workers
+                    if TIER_FULL < w.current_tier() < TIER_SHED]
+        if not eligible:
+            return False
+        w = min(eligible,
+                key=lambda w: (-w.current_tier(), w.device_cost()))
+        return w.set_tier(w.current_tier() - 1, "sustained calm")
+
+    def _ladder_loop(self) -> None:
+        while not self._ladder_stop.wait(self.ladder_tick_s):
+            try:
+                self._ladder_step(_time.monotonic())
+            except Exception:  # noqa: BLE001 — keep controlling
+                log.warning("service: ladder tick failed",
+                            exc_info=True)
+
     # -- drain / resume ----------------------------------------------------
 
     def drain(self, timeout_s: float = 60.0) -> None:
@@ -850,12 +1423,21 @@ class VerificationService:
         log.info("service: draining %d streams",
                  sum(1 for w in workers if not w.done.is_set()))
         self._watch_stop.set()
+        self._ladder_stop.set()
         for w in workers:
             if not w.done.is_set():
                 w._drain.set()
         deadline = _time.monotonic() + timeout_s
         for w in workers:
             w.done.wait(max(0.0, deadline - _time.monotonic()))
+        if self.calibration_path:
+            try:
+                self.calibration.save(self.calibration_path)
+                log.info("service: calibration saved to %s",
+                         self.calibration_path)
+            except OSError:
+                log.warning("service: could not persist calibration",
+                            exc_info=True)
         self.drained.set()
         log.info("service: drained")
 
@@ -1032,6 +1614,11 @@ class VerificationService:
             workers = dict(self.workers)
             draining = self.draining
             admitted, refused = self.admitted_total, self.refused_total
+            transitions = self.ladder_transitions_total
+        tiers = dict.fromkeys(TIER_NAMES, 0)
+        for w in workers.values():
+            if not w.done.is_set():
+                tiers[TIER_NAMES[w.current_tier()]] += 1
         return {
             "state": ("drained" if self.drained.is_set()
                       else "draining" if draining else "serving"),
@@ -1044,6 +1631,13 @@ class VerificationService:
             "quarantined": sorted(n for n, w in workers.items()
                                   if w.state == QUARANTINED),
             "budget": self.budget.status(),
+            "ladder": {"adaptive": self.adaptive,
+                       "tiers": tiers,
+                       "transitions": transitions},
+            "calibration": {
+                "platform": self.calibration.platform,
+                "coefficients": self.calibration.coefficients(),
+            },
             # the service-layer registry slice: stream lifecycle
             # counters, budget gauges, queue-depth/verb histograms
             "telemetry": _telemetry.snapshot(
@@ -1082,6 +1676,7 @@ class VerificationService:
         """Hard stop (after drain, or for tests): close the socket and
         stop watching."""
         self._watch_stop.set()
+        self._ladder_stop.set()
         if self._server is not None:
             try:
                 self._server.close()
