@@ -28,11 +28,15 @@ test:
 # overload-control suite (seconds, no kernel compiles beyond the small
 # fault matrices) — run before the full tier-1 sweep so a broken
 # invariant/observability/structural/scheduling layer fails in the
-# first minute, not the fortieth. CI runs this first.
+# first minute, not the fortieth. CI runs this first. The search smoke
+# excludes the A/B acceptance demo and the service round trip (both
+# run in tier1); the rest of tests/test_search.py is seconds.
 tier0: staticcheck
 	$(PY) -m pytest tests/test_screen.py tests/test_attest.py \
 		tests/test_telemetry.py tests/test_staticcheck.py \
 		tests/test_adaptive.py -q
+	$(PY) -m pytest tests/test_search.py -q \
+		-k 'not ab_demo and not service_escalation'
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
 # holds the larger shape sweeps, e.g. the pallas dedup parity sweep).

@@ -1157,6 +1157,40 @@ def section_generator():
         n_gen / (time.monotonic() - t0), 1)}
 
 
+def section_search():
+    """Coverage-guided vs pure-random scenario search, CPU-pinned
+    (doc/search.md): same planted conjunction bug, same seed universe,
+    same fixed simulation budget — the A/B the subsystem exists for.
+    Reports whether each strategy found the violation, sims-to-find,
+    and corpus coverage."""
+    from jepsen_tpu.search.driver import SearchConfig, run_search
+
+    out: dict = {}
+    for strategy in ("guided", "random"):
+        t0 = time.monotonic()
+        r = run_search(SearchConfig(
+            workload="phased-register", strategy=strategy,
+            bug="lost-write-kill-partition",
+            generations=16, population=25, seed=2,
+            max_sims=400, workers=4, escalate="none"))
+        v = r["violations"][0] if r["violations"] else None
+        out[strategy] = {
+            "found": r["found"],
+            "simulations": r["simulations"],
+            "found_at_sim": v["found-at-sim"] if v else None,
+            "shrink_steps": r["shrink-steps"],
+            "coverage_bits": r["coverage-bits"],
+            "corpus_genomes": r["corpus-size"],
+            "seconds": round(time.monotonic() - t0, 3),
+        }
+        sims = max(1, r["simulations"])
+        out[strategy]["sims_per_s"] = round(
+            sims / max(1e-9, out[strategy]["seconds"]), 1)
+    out["separation"] = bool(out["guided"]["found"]
+                             and not out["random"]["found"])
+    return out
+
+
 # (name, fn, timeout_s, touches_device).  Budgets are generous: they
 # exist to bound a wedged relay, not to race healthy runs.
 SECTIONS = [
@@ -1175,6 +1209,7 @@ SECTIONS = [
     ("adaptive", section_adaptive, 600, True),
     ("telemetry", section_telemetry, 420, False),
     ("generator", section_generator, 180, False),
+    ("search", section_search, 420, False),
 ]
 
 # nested-only sections (invoked by other sections, never scheduled by

@@ -721,9 +721,90 @@ def staticcheck_cmd() -> dict:
     }}
 
 
+def search_cmd() -> dict:
+    """`jepsen-tpu search` — coverage-guided scenario search over
+    generator/nemesis schedules (doc/search.md). Simulates genome
+    populations, accumulates schedule coverage, escalates suspicious
+    histories to the full checker, and shrinks found violations to a
+    minimal reproducing scenario. Exits 0 when the budget ends with no
+    violation, 1 when one was found (its minimized genome is in the
+    output and the --store-dir artifact)."""
+    def run_search_cmd(options):
+        import json as _json
+
+        from . import report
+        from .search.driver import SearchConfig, run_search
+        from .search.scenario import BUGS, SCENARIOS
+
+        if options.get("workload") not in SCENARIOS:
+            print(f"unknown workload {options.get('workload')!r}; "
+                  f"have {sorted(SCENARIOS)}", file=sys.stderr)
+            raise SystemExit(254)
+        if options.get("bug") and options["bug"] not in BUGS:
+            print(f"unknown bug {options['bug']!r}; "
+                  f"have {sorted(BUGS)}", file=sys.stderr)
+            raise SystemExit(254)
+        cfg = SearchConfig(
+            workload=options["workload"],
+            generations=options["generations"],
+            population=options["population"],
+            seed=options["seed"],
+            workers=options["workers"],
+            strategy=options["strategy"],
+            escalate=options["escalate"],
+            bug=options.get("bug") or None,
+            max_sims=options.get("max_sims"),
+            sample=options["sample"],
+            store_dir=options.get("store_dir"),
+        )
+        results = run_search(cfg)
+        print(_json.dumps(results, indent=2, sort_keys=True))
+        line = report.search_line(results)
+        if line:
+            print(line, file=sys.stderr)
+        raise SystemExit(1 if results["found"] else 0)
+
+    return {"search": {
+        "opt_spec": [
+            opt("--workload", "-w", default="register",
+                help="Search scenario (jepsen_tpu.search.scenario"
+                     ".SCENARIOS)"),
+            opt("--generations", "-g", type=int, default=10,
+                help="Search generations"),
+            opt("--population", "-k", type=int, default=50,
+                help="Genomes per generation"),
+            opt("--seed", "-s", type=int, default=45100,
+                help="Search seed (sampling + mutation)"),
+            opt("--workers", type=int, default=4,
+                help="Simulation worker threads"),
+            opt("--strategy", default="guided",
+                choices=["guided", "random"],
+                help="guided (coverage feedback) or random "
+                     "(uniform draws, the A/B baseline)"),
+            opt("--escalate", default="none",
+                choices=["none", "host", "batch", "service"],
+                help="Full-checker escalation path for suspicious "
+                     "histories"),
+            opt("--bug", default=None,
+                help="Planted executor bug "
+                     "(jepsen_tpu.search.scenario.BUGS; demos/tests)"),
+            opt("--max-sims", type=int, default=None,
+                help="Total simulation budget (default: unlimited "
+                     "within generations x population + shrinking)"),
+            opt("--sample", type=float, default=0.0,
+                help="Clean-history audit escalation fraction"),
+            opt("--store-dir", default=None, metavar="DIR",
+                help="Write search.json + coverage.bin here"),
+        ],
+        "usage": "Coverage-guided scenario search (doc/search.md)",
+        "run": run_search_cmd,
+    }}
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     logging.basicConfig(level=logging.INFO)
-    run({**serve_cmd(), **service_cmd(), **staticcheck_cmd()}, argv)
+    run({**serve_cmd(), **service_cmd(), **staticcheck_cmd(),
+         **search_cmd()}, argv)
 
 
 if __name__ == "__main__":

@@ -35,6 +35,7 @@ import dataclasses
 import inspect
 import logging
 import random
+import threading
 from typing import Any, Callable, Optional
 
 LOG = logging.getLogger("jepsen_tpu.generator")
@@ -51,25 +52,54 @@ class _Pending:
 
 PENDING = _Pending()
 
-rng = random.Random()
+_RNG_TLS = threading.local()
+_DEFAULT_RNG = random.Random()
+
+
+class _RngProxy:
+    """`gen.rng`, made worker-safe: delegates every method to the
+    calling thread's pinned stream (`fixed_rng`) or, unpinned, to one
+    process-wide default. Pinning used to rebind the module global, so
+    N concurrent `simulate()` workers shared (and clobbered) a single
+    seed-45100 stream; with thread-local pinning each worker owns an
+    independent deterministic stream and unrelated threads never see
+    another worker's seed. Attribute lookup is the only indirection —
+    call sites (`gen.rng.randrange(...)`) are unchanged."""
+
+    @staticmethod
+    def _current() -> random.Random:
+        return getattr(_RNG_TLS, "rng", None) or _DEFAULT_RNG
+
+    def __getattr__(self, name):
+        return getattr(self._current(), name)
+
+    def __repr__(self):
+        pinned = getattr(_RNG_TLS, "rng", None) is not None
+        return f"<generator.rng {'pinned' if pinned else 'default'}>"
+
+
+rng = _RngProxy()
 
 
 class fixed_rng:
-    """Context manager pinning this module's RNG to a seeded stream for
-    deterministic simulation (reference seed 45100, test.clj:44-48)."""
+    """Context manager pinning the *calling thread's* RNG to a seeded
+    stream for deterministic simulation (reference seed 45100,
+    test.clj:44-48). Reentrant — nesting saves and restores the outer
+    pin — and thread-safe: concurrent workers each pin their own
+    stream (the search driver runs hundreds of parallel `simulate()`
+    calls; see jepsen_tpu/search/driver.py)."""
 
     def __init__(self, seed: int = 45100):
         self.seed = seed
 
     def __enter__(self):
-        global rng
-        self._saved = rng
-        rng = random.Random(self.seed)
-        return rng
+        self._saved = getattr(_RNG_TLS, "rng", None)
+        r = random.Random(self.seed)
+        _RNG_TLS.rng = r
+        return r
 
     def __exit__(self, *exc):
-        global rng
-        rng = self._saved
+        _RNG_TLS.rng = self._saved
         return False
 
 

@@ -141,6 +141,29 @@ def service_line(status: dict) -> str:
     return line
 
 
+def search_line(results: dict) -> str:
+    """One printable line summarizing a coverage-guided scenario
+    search (the search.driver.run_search result shape), or '' for
+    anything else — for report `to` blocks and operator logs."""
+    r = results or {}
+    if not isinstance(r.get("coverage-bits"), int) \
+            or "simulations" not in r:
+        return ""
+    line = (f"search ({r.get('strategy', '?')}): "
+            f"{r['simulations']} simulations over "
+            f"{r.get('generations-run', 0)} generations, "
+            f"{r['coverage-bits']} coverage bits, "
+            f"corpus {r.get('corpus-size', 0)} genomes")
+    viols = r.get("violations") or []
+    if viols:
+        steps = sum(int(v.get("shrink-steps", 0) or 0)
+                    for v in viols)
+        line += (f"; {len(viols)} violation"
+                 f"{'s' if len(viols) != 1 else ''}, minimized in "
+                 f"{steps} shrink steps")
+    return line
+
+
 @contextlib.contextmanager
 def to(filename: str, tee: bool = True):
     """Context manager: stdout inside the block is written to filename

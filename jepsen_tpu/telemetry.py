@@ -50,9 +50,9 @@ from typing import Callable, Iterable
 # enforces this over every registered metric; keep the sets in sync
 # with the doc catalog in doc/observability.md)
 LAYERS = ("wgl", "streaming", "screen", "abft", "service", "trace",
-          "run", "web")
+          "run", "web", "search")
 UNITS = ("total", "seconds", "rows", "ops", "chunks", "elementops",
-         "bytes", "ratio", "streams", "info")
+         "bytes", "ratio", "streams", "info", "bits", "genomes")
 
 METRICS_ENV = "JEPSEN_TPU_METRICS"
 PROFILE_ENV = "JEPSEN_TPU_PROFILE"

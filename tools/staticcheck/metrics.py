@@ -34,6 +34,7 @@ MODULES = (
     "jepsen_tpu.checker.abft",
     "jepsen_tpu.service",
     "jepsen_tpu.web",
+    "jepsen_tpu.search.driver",
 )
 
 REGISTRY_PATH = "<metrics-registry>"
