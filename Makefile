@@ -30,13 +30,18 @@ test:
 # invariant/observability/structural/scheduling layer fails in the
 # first minute, not the fortieth. CI runs this first. The search smoke
 # excludes the A/B acceptance demo and the service round trip (both
-# run in tier1); the rest of tests/test_search.py is seconds.
+# run in tier1); the rest of tests/test_search.py is seconds. The
+# kill-and-recover smoke SIGKILLs a service daemon mid-stream and
+# asserts recover() reproduces the solo verdicts byte-for-byte — the
+# crash-consistency contract gates here even though the test carries
+# the slow marker (tier1 filters it out; tier0 names it explicitly).
 tier0: staticcheck
 	$(PY) -m pytest tests/test_screen.py tests/test_attest.py \
 		tests/test_telemetry.py tests/test_staticcheck.py \
 		tests/test_adaptive.py -q
 	$(PY) -m pytest tests/test_search.py -q \
 		-k 'not ab_demo and not service_escalation'
+	$(PY) -m pytest tests/test_service_crash.py -q -k 'sigkill'
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
 # holds the larger shape sweeps, e.g. the pallas dedup parity sweep).

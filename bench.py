@@ -901,6 +901,151 @@ def section_service():
     }}
 
 
+def section_failover():
+    """Crash-consistency latency (jepsen_tpu/service.py): the
+    detect -> fence -> promote -> first-verdict path of a Standby
+    taking over a dead primary's store, and the session protocol's
+    reconnect-storm throughput (forced socket drops mid-stream) vs an
+    undisturbed connection.
+
+    Device-light like the service section: the kernels are the
+    streaming section's; what this measures is the failover control
+    plane (health probes, epoch fencing, checkpoint recovery) and the
+    wire protocol's replay cost."""
+    import json as _json
+    import shutil as _shutil
+    import socket as _socket
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from jepsen_tpu import service as _service, store as _store
+    from jepsen_tpu.checker import synth
+
+    model = _model()
+    n = max(N_OPS // 20, 400)
+    chunk = 64
+    slots = 8
+    frontier = 128
+
+    def jops(h):
+        return [_json.loads(_json.dumps(op,
+                                        default=_store._json_default))
+                for op in h.ops]
+
+    def spec():
+        return {"linear": {
+            "kind": "wgl", "model": _service.model_spec(model),
+            "chunk-entries": chunk, "slots": slots, "engine": "sort",
+            "frontier": frontier, "checkpoint-every": 2}}
+
+    ops = jops(synth.register_history(n, concurrency=3, values=5,
+                                      seed=412))
+    tmp = _tempfile.mkdtemp(prefix="bench-failover-")
+    out: dict = {"shape": f"{n}-op register stream (conc 3, "
+                          f"chunk {chunk}, F {frontier})"}
+    try:
+        # -- standby promotion: detect -> fence -> promote -> verdict
+        root = os.path.join(tmp, "store")
+        run_dir = os.path.join(root, "bench", "t0")
+        os.makedirs(run_dir)
+        with open(os.path.join(run_dir, "journal.jsonl"), "w") as fh:
+            for op in ops:
+                fh.write(_json.dumps(
+                    op, default=_store._json_default) + "\n")
+        import gzip as _gzip
+        with _gzip.open(os.path.join(run_dir, "history.jsonl.gz"),
+                        "wt") as fh:
+            for op in ops:
+                fh.write(_json.dumps(
+                    op, default=_store._json_default) + "\n")
+        primary = _service.VerificationService()
+        primary.claim_store(root)
+        addr = primary.serve("127.0.0.1:0")
+        primary.admit("bench/t0", spec(), store_dir=run_dir)
+        for op in ops[:3 * len(ops) // 4]:
+            primary.offer("bench/t0", op)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            m = _store.load_service_resume(run_dir)
+            if m and any("carry" in c
+                         for c in m.get("checkpoints", {}).values()):
+                break
+            time.sleep(0.02)
+        else:
+            raise RuntimeError("no durable checkpoint before kill")
+        standby_svc = _service.VerificationService()
+        sb = _service.Standby(standby_svc, addr, root,
+                              bind="127.0.0.1:0", poll_s=0.05,
+                              failures=2)
+        th = _threading.Thread(target=sb.run, daemon=True)
+        th.start()
+        t_kill = time.monotonic()
+        primary.stop()           # the "SIGKILL": acceptor + workers die
+        assert sb.promoted.wait(180.0), "standby never promoted"
+        promote_s = time.monotonic() - t_kill
+        res_path = os.path.join(run_dir, _store.STREAMED_RESULTS_FILE)
+        while time.monotonic() - t_kill < 300:
+            if os.path.exists(res_path):
+                try:
+                    with open(res_path) as fh:
+                        r = _json.load(fh)
+                    break
+                except ValueError:
+                    pass             # mid-write
+            time.sleep(0.02)
+        else:
+            raise RuntimeError("no verdict after promotion")
+        verdict_s = time.monotonic() - t_kill
+        assert r["linear"]["valid?"] is True, r
+        out["standby"] = {
+            "detect_fence_promote_s": round(promote_s, 3),
+            "kill_to_verdict_s": round(verdict_s, 3),
+            "recovered_streams": standby_svc.recovered_total,
+            "standby_epoch": standby_svc.epoch,
+        }
+        sb.stop()
+        standby_svc.stop()
+
+        # -- reconnect storm vs steady-state client throughput -------
+        def feed(name, drops):
+            svc = _service.VerificationService()
+            a = svc.serve("127.0.0.1:0")
+            test = {"name": name, "start-time": "0",
+                    "store-dir": os.path.join(tmp, name)}
+            c = _service.ServiceClient(a, test, spec=spec())
+            marks = {len(ops) * k // (drops + 1)
+                     for k in range(1, drops + 1)} if drops else set()
+            t0 = time.monotonic()
+            for i, op in enumerate(ops):
+                if i in marks:
+                    # cut the live connection under the client; the
+                    # next offer reconnects and replays unacked ops
+                    try:
+                        c._wrap.conn().sock.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass     # already mid-reconnect
+
+                c.offer(op)
+            r = c.finalize()
+            wall = time.monotonic() - t0
+            assert r["linear"]["valid?"] is True, r
+            st = svc.status()
+            svc.stop()
+            return {"wall_s": round(wall, 3),
+                    "ops_per_s": round(len(ops) / wall, 1),
+                    "reconnects": c.reconnects,
+                    "replays": st["sessions"]["replays"]}
+        steady = feed("steady", 0)
+        storm = feed("storm", 8)
+        out["client"] = {
+            "steady": steady, "storm_8_drops": storm,
+            "storm_overhead_x": round(
+                storm["wall_s"] / max(steady["wall_s"], 1e-4), 2)}
+    finally:
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return {"failover": out}
+
+
 def section_adaptive():
     """Static vs adaptive budget under a 16-stream overload mix (the
     ISSUE-12 control plane, doc/robustness.md `Adaptive overload
@@ -1206,6 +1351,7 @@ SECTIONS = [
     ("config4", section_config4, 900, True),
     ("config5", section_config5, 1200, True),
     ("service", section_service, 600, True),
+    ("failover", section_failover, 600, True),
     ("adaptive", section_adaptive, 600, True),
     ("telemetry", section_telemetry, 420, False),
     ("generator", section_generator, 180, False),

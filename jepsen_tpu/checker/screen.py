@@ -220,6 +220,12 @@ class ScreenStream:
         self._added: set = set()
         self._completed_adds: dict = {}  # element -> completion t
 
+    def export_checkpoint(self) -> dict:
+        """Screens are host-side and O(n): a recovering service
+        re-feeds them from the journal, so the durable manifest only
+        records progress (kind='host' = nothing to import)."""
+        return {"kind": "host", "ops-fed": int(self.client_ops)}
+
     # -- feeding -----------------------------------------------------------
 
     def feed(self, op: dict) -> None:
@@ -422,6 +428,11 @@ class WrScreen:
                 self._ws._g1a or self._ws._g1b or self._ws._internal
                 or self._ws._duplicates):
             self.violation = True
+
+    def export_checkpoint(self) -> dict:
+        """See ScreenStream.export_checkpoint: progress only."""
+        return {"kind": "host",
+                "ops-fed": int(self._ws.client_ops_fed)}
 
     @property
     def suspicion(self) -> float:

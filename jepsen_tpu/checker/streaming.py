@@ -398,6 +398,11 @@ class WglStream:
         self._trail = _wgl._RecoveryTrail(max_recovery_retries)
         # (rows consumed, chunks dispatched, host-resident carry)
         self._ckpt: tuple[int, int, tuple] | None = None
+        # bumped whenever the recovery target changes (a cadence/
+        # forced checkpoint lands, or a rebuild invalidates it) — a
+        # service watches this to know when to persist the export
+        # durably without re-fetching or comparing carries
+        self.checkpoint_seq = 0
         # an imported (cross-process) checkpoint waiting to seed the
         # carry at the next kernel build — see import_checkpoint()
         self._restore_ckpt_pending = False
@@ -659,6 +664,7 @@ class WglStream:
                                       site="stream checkpoint")
         self._ckpt = (self._rows_done, self._chunks, host)
         self._ckpt_att = (self._att_steps, self._att_carry)
+        self.checkpoint_seq += 1
 
     def _recovering(self, fn: Callable[[], Any], site: str,
                     restore: bool = True):
@@ -759,7 +765,10 @@ class WglStream:
         self._chunks = 0
         # a rebuild replaces the kernel family/shape: the old carry
         # checkpoint no longer matches and the steps log restarts
+        # (checkpoint_seq still bumps — a durably persisted export of
+        # the dead checkpoint must be superseded, not left current)
         self._ckpt = None
+        self.checkpoint_seq += 1
         self._restore_ckpt_pending = False
         self._rows_fed = self._rows_done = 0
         self._dead = self._dead_overflow = False
@@ -1279,6 +1288,14 @@ class WrStream:
         self._g1b: list = []
         self._duplicates: list = []
         self.client_ops_fed = 0
+
+    def export_checkpoint(self) -> dict:
+        """Host streams carry no device state worth persisting: the
+        durable manifest records progress counters only, and a
+        recovered stream re-derives everything by re-feeding the
+        journal (one cheap host-side pass). kind='host' tells a
+        resuming service there is nothing to import."""
+        return {"kind": "host", "ops-fed": int(self.client_ops_fed)}
 
     # edge helper — masks as in kernels (_WW=1, _WR=2, _RW=4)
     def _edge(self, i: int, j: int, mask: int) -> None:

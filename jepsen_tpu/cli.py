@@ -600,7 +600,11 @@ def service_cmd() -> dict:
             calibration=cal,
             adaptive=not options.get("static_budget"))
         svc.calibration_path = _calibrate.default_path(cal.platform)
-        bound = svc.serve(options.get("bind") or "127.0.0.1:0")
+        standby = options.get("standby")
+        if standby and not options.get("watch"):
+            print("--standby requires --watch DIR (the shared store "
+                  "root the replicas fence over)", file=sys.stderr)
+            raise SystemExit(2)
         msrv = None
         if options.get("metrics_port") is not None:
             from . import telemetry
@@ -612,10 +616,31 @@ def service_cmd() -> dict:
             log.info("metrics on http://%s:%d/metrics "
                      "(/healthz = service status)", mhost, mport)
             print(f"Metrics listening on :{mport}/metrics")
-        if options.get("watch"):
-            svc.watch(options["watch"])
-            log.info("watching journals under %s", options["watch"])
         svc.install_sigterm()
+        if standby:
+            sb = _service.Standby(
+                svc, standby, options["watch"],
+                bind=options.get("bind") or "127.0.0.1:0")
+            print(f"Standby replica watching primary {standby} "
+                  f"(store {options['watch']})")
+            bound = sb.run()    # blocks until promotion (or drain)
+            if bound is None:
+                svc.stop()
+                if msrv is not None:
+                    msrv.shutdown()
+                return
+        else:
+            if options.get("watch"):
+                # claim the store and resume any streams a crashed
+                # predecessor orphaned — then keep tail-following
+                recovered = svc.recover(options["watch"])
+                if recovered:
+                    print(f"Recovered {len(recovered)} orphaned "
+                          f"stream(s) from {options['watch']}")
+                svc.watch(options["watch"])
+                log.info("watching journals under %s",
+                         options["watch"])
+            bound = svc.serve(options.get("bind") or "127.0.0.1:0")
         print(f"Verification service listening on {bound}")
         try:
             while not svc.drained.is_set():
@@ -637,8 +662,15 @@ def service_cmd() -> dict:
                      "socket path to listen on"),
             opt("--watch", metavar="DIR", default=None,
                 help="Also tail-follow journals under this store "
-                     "directory (resumes drained runs from their "
-                     "service manifests)."),
+                     "directory. On start, recover() resumes any "
+                     "orphaned runs from their durable checkpoints "
+                     "(crashed or drained predecessors alike)."),
+            opt("--standby", metavar="ADDR", default=None,
+                help="Run as a warm replica: probe ADDR (a primary's "
+                     "socket address or its http://.../healthz), and "
+                     "on sustained failure fence it via the store-"
+                     "level epoch file, recover its streams, and "
+                     "serve. Requires --watch DIR (the shared store)."),
             opt("--max-streams", type=int, default=64, metavar="N",
                 help="Admission cap on concurrently attached runs."),
             opt("--budget-elementops", type=float, default=None,

@@ -124,6 +124,16 @@ def service_line(status: dict) -> str:
                    if s.get("ladder-tier") not in (None, "full"))
     if degraded:
         line += f"; {degraded} ladder-degraded"
+    # crash-consistency fields (older services' status dicts carry
+    # none of these)
+    if st.get("recovered-total"):
+        line += (f"; {st['recovered-total']} recovered"
+                 f" (epoch {st.get('epoch', 0)})")
+    sessions = st.get("sessions") or {}
+    if sessions.get("replays"):
+        line += f"; {sessions['replays']} op replays deduped"
+    if st.get("fenced"):
+        line += "; FENCED (another replica owns the store)"
     budget = st.get("budget") or {}
     if budget.get("initial"):
         line += (f"; budget {budget.get('capacity', 0):.3g}/"

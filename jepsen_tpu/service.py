@@ -77,7 +77,8 @@ import zlib
 from collections import deque
 from typing import Callable
 
-from . import calibrate as _calibrate, store, telemetry as _telemetry
+from . import calibrate as _calibrate, reconnect as _reconnect, store, \
+    telemetry as _telemetry, trace as _trace
 
 log = logging.getLogger(__name__)
 
@@ -124,9 +125,41 @@ _M_TIER = _telemetry.gauge(
 _M_VERB = _telemetry.histogram(
     "jepsen_tpu_service_verb_seconds",
     "Socket verb handling latency", ("verb",))
+_M_RECOVERIES = _telemetry.counter(
+    "jepsen_tpu_service_recoveries_total",
+    "Streams resumed by recover() after an ungraceful death, by "
+    "resume source (checkpoint = durable carry restored, cold = "
+    "journal re-check from scratch)", ("how",))
+_M_REPLAYS = _telemetry.counter(
+    "jepsen_tpu_service_replays_total",
+    "Duplicate session ops deduplicated by the server-side sequence "
+    "table (at-least-once delivery, exactly-once application)")
+_M_RECONNECTS = _telemetry.counter(
+    "jepsen_tpu_service_reconnects_total",
+    "Session re-attaches after a dropped connection, by side",
+    ("side",))
+_M_FAILOVERS = _telemetry.counter(
+    "jepsen_tpu_service_failovers_total",
+    "Replica failovers: standby promotions and client address-list "
+    "failovers", ("role",))
 
 _KNOWN_VERBS = frozenset(
     {"op", "attach", "poll", "finish", "status", "metrics", "close"})
+
+# the socket layer's line cap: a single journal op is a few hundred
+# bytes; anything near this is garbage or an attack on the reader's
+# memory, and gets an error reply instead of an allocation
+MAX_LINE_BYTES = 1 << 20
+# client acks: every Nth op asks the server for its sequence
+# high-water mark so the replay buffer stays bounded
+ACK_EVERY = 64
+# reconnect attempts (each cycles the whole address list) before the
+# client declares the service gone and falls back to offline checking
+RECONNECT_TRIES = 8
+# standby failover defaults: consecutive failed health probes (at
+# poll_s cadence) before the standby fences the primary and promotes
+DEFAULT_STANDBY_POLL_S = 1.0
+DEFAULT_STANDBY_FAILURES = 3
 
 # stream lifecycle states (see module docstring)
 ADMITTED = "admitted"
@@ -657,6 +690,13 @@ class StreamWorker:
         self.shed_reason: str | None = None
         self._drain = threading.Event()
         self._dead_targets: set[str] = set()
+        # durable periodic checkpoints (worker thread only): the last
+        # persisted per-target checkpoint_seq snapshot, plus a flag
+        # forcing one persist right after admission — a SIGKILL before
+        # the first carry checkpoint must still leave a manifest so
+        # recover() resumes the stream cold, no drain required
+        self._persisted_seqs: dict[str, int] = {}
+        self._persist_pending = bool(store_dir)
         self._costs = {n: chunk_cost(t, service.calibration)
                        for n, t in self.targets.items()
                        if hasattr(t, "pending_chunks")}
@@ -872,6 +912,7 @@ class StreamWorker:
             self.refresh_suspicion()
             self._pump()
             self._note_violation()
+            self._maybe_persist()
             if sealed and self.q.empty():
                 self._finish()
                 return
@@ -1036,7 +1077,7 @@ class StreamWorker:
                 }
         self.results = out
         self.state = VERDICT
-        if self.store_dir:
+        if self.store_dir and not self.service.fenced():
             try:
                 store.write_streamed_results(self.store_dir, out)
                 store.clear_service_resume(self.store_dir)
@@ -1066,37 +1107,96 @@ class StreamWorker:
         except _queue.Empty:
             pass
 
-    def _do_drain(self) -> None:
-        """Checkpoint every WGL target and persist the resume manifest
-        + any partial verdicts into the run's store dir."""
+    def _maybe_persist(self) -> None:
+        """Durable periodic checkpoints: whenever a target stored a
+        fresh carry checkpoint since the last persist (its
+        ``checkpoint_seq`` moved — every ``checkpoint_every`` cycle),
+        atomically persist the exported carries + journal offset +
+        attestation tallies into the run's store dir. A SIGKILL then
+        recovers from the last persisted checkpoint instead of
+        re-checking cold — no drain manifest required."""
+        if not self.store_dir:
+            return
+        seqs = {n: t.checkpoint_seq for n, t in self.targets.items()
+                if n not in self._dead_targets
+                and hasattr(t, "checkpoint_seq")}
+        if not self._persist_pending and seqs == self._persisted_seqs:
+            return
+        if self._persist_checkpoints():
+            self._persisted_seqs = seqs
+            self._persist_pending = False
+
+    def _export_checkpoints(self) -> dict:
+        """Every live target's exportable checkpoint (WGL carries plus
+        host streams' progress markers); a target whose export breaks
+        is left out — it resumes cold from the journal."""
         checkpoints: dict = {}
+        for name, t in self.targets.items():
+            if name in self._dead_targets \
+                    or not hasattr(t, "export_checkpoint"):
+                continue
+            try:
+                ck = t.export_checkpoint()
+            except Exception:  # noqa: BLE001 — persist is best-effort
+                log.warning("service %s: could not export %r's "
+                            "checkpoint; it will resume cold",
+                            self.name, name, exc_info=True)
+                continue
+            if ck is not None:
+                checkpoints[name] = ck
+        return checkpoints
+
+    def _persist_checkpoints(self,
+                             checkpoints: dict | None = None) -> bool:
+        """Write the resume manifest atomically (tmp-then-rename in
+        store.write_service_resume) into the run's store dir — unless
+        this service has been fenced out of the store by a promoted
+        standby, whose recovered state must win over a zombie's late
+        writes."""
+        if self.service.fenced():
+            return False
+        if checkpoints is None:
+            checkpoints = self._export_checkpoints()
+        try:
+            store.write_service_resume(self.store_dir, {
+                "stream": self.name,
+                "targets": self.spec,
+                "ops-fed": self.ops_fed,
+                "journal-offset": self.ops_fed,
+                "epoch": self.service.epoch,
+                "checkpoints": checkpoints,
+            })
+            return True
+        except OSError:
+            log.warning("service %s: could not persist the resume "
+                        "manifest", self.name, exc_info=True)
+            return False
+
+    def _do_drain(self) -> None:
+        """Checkpoint every WGL target at the exact drain point and
+        persist the resume manifest + any partial verdicts into the
+        run's store dir."""
         for name, t in self.targets.items():
             if name in self._dead_targets \
                     or not hasattr(t, "checkpoint_now"):
                 continue
             try:
                 t.checkpoint_now()
-                ck = t.export_checkpoint()
-                if ck is not None:
-                    checkpoints[name] = ck
             except Exception:  # noqa: BLE001 — drain is best-effort
                 log.warning("service %s: checkpoint of %r failed at "
-                            "drain; it will resume cold", self.name,
-                            name, exc_info=True)
+                            "drain; it resumes from its last periodic "
+                            "checkpoint", self.name, name,
+                            exc_info=True)
         if self.store_dir:
-            try:
-                store.write_service_resume(self.store_dir, {
-                    "stream": self.name,
-                    "targets": self.spec,
-                    "ops-fed": self.ops_fed,
-                    "checkpoints": checkpoints,
-                })
-                if self.results:
+            self._persist_checkpoints()
+            if self.results and not self.service.fenced():
+                try:
                     store.write_streamed_results(self.store_dir,
                                                  self.results)
-            except OSError:
-                log.warning("service %s: could not persist the resume "
-                            "manifest", self.name, exc_info=True)
+                except OSError:
+                    log.warning("service %s: could not persist "
+                                "partial verdicts", self.name,
+                                exc_info=True)
         self.state = DRAINED
         self._terminal("drained")
 
@@ -1126,7 +1226,7 @@ class StreamWorker:
         self.state = SHED
         log.warning("service %s: shed (%s); offline analyze covers "
                     "it from the journal", self.name, reason)
-        if self.store_dir:
+        if self.store_dir and not self.service.fenced():
             try:
                 store.write_streamed_results(
                     self.store_dir,
@@ -1168,6 +1268,23 @@ class StreamWorker:
 # ---------------------------------------------------------------------------
 # the service
 # ---------------------------------------------------------------------------
+
+class _Session:
+    """One client session's server-side wire-protocol state: the
+    sequence high-water mark that turns at-least-once delivery into
+    exactly-once application. Every field is guarded by the service's
+    ``_session_lock`` (the table's own lock — see __init__)."""
+
+    __slots__ = ("token", "seq", "replays", "journal_fed")
+
+    def __init__(self, token: str, journal_fed: bool = False):
+        self.token = token          # the client's opaque identity
+        self.seq = 0                # highest op sequence applied
+        self.replays = 0            # duplicate ops dropped
+        # a journal-fed stream is driven by the store tail (recover or
+        # watch); socket ops would double-apply and are dropped
+        self.journal_fed = journal_fed
+
 
 class VerificationService:
     """See the module docstring. In-process API first (admit / offer /
@@ -1229,12 +1346,26 @@ class VerificationService:
         # and the watcher thread
         self._tails: dict[str, tuple] = {}      # guarded-by: _lock
         self._finished_dirs: set[str] = set()   # guarded-by: _lock
+        # -- session table (the session-resilient wire protocol).
+        # Its own lock, always taken sequentially with _lock, never
+        # nested inside it (the JTS202 order discipline).
+        self._session_lock = threading.Lock()
+        self._sessions: dict[str, _Session] = {}  # guarded-by: _session_lock
+        # -- crash consistency / replica failover state. claim_store
+        # runs before any worker exists (single-threaded start or
+        # standby promotion), so epoch/store_root need no lock; _fenced
+        # is a monotonic False->True flag like ServiceClient._closed.
+        self.store_root: str | None = None
+        self.epoch = 0
+        self._fenced = False
+        self.recovered_total = 0        # guarded-by: _lock
 
     # -- admission ---------------------------------------------------------
 
     def admit(self, name: str, spec: dict,
               store_dir: str | None = None,
               overrides: dict | None = None) -> StreamWorker:
+        self.fenced()   # a fenced-out instance flips itself draining
         with self._lock:
             if self.draining:
                 self.refused_total += 1
@@ -1261,6 +1392,7 @@ class VerificationService:
             _M_ACTIVE.inc()
         w.thread.start()
         self._ensure_ladder()
+        self._prune_sessions()
         log.info("service: admitted stream %r (targets %s)", name,
                  sorted(w.targets))
         return w
@@ -1457,10 +1589,18 @@ class VerificationService:
         man = store.load_service_resume(run_dir)
         if man is None:
             return None
+        if not man.get("targets"):
+            log.warning("service: resume manifest in %s carries no "
+                        "targets spec; ignoring it", run_dir)
+            return None
         name = man.get("stream") or os.path.basename(run_dir)
         overrides = {}
         ck_by_target = man.get("checkpoints") or {}
         for target, ck in ck_by_target.items():
+            if ck.get("kind") == "host" or "p" not in ck:
+                # host streams checkpoint progress only — they rebuild
+                # from the re-fed journal, no kernel-shape overrides
+                continue
             overrides[target] = {
                 "p": ck.get("p"), "chunk": ck.get("chunk"),
                 "frontier": ck.get("frontier"),
@@ -1473,7 +1613,8 @@ class VerificationService:
         _M_EVENTS.labels(event="resumed").inc()
         for target, ck in ck_by_target.items():
             t = w.targets.get(target)
-            if t is not None and hasattr(t, "import_checkpoint"):
+            if t is not None and hasattr(t, "import_checkpoint") \
+                    and "carry" in ck:
                 try:
                     if t.import_checkpoint(ck):
                         log.info("service %s: %r resuming from chunk "
@@ -1484,6 +1625,175 @@ class VerificationService:
                                 exc_info=True)
         self._tail_run(run_dir, name)
         return name
+
+    # -- crash recovery / replica failover ---------------------------------
+
+    def claim_store(self, store_root: str) -> int:
+        """Take ownership of a store root: bump its service epoch so
+        any prior owner still running is fenced the moment it next
+        checks, and remember ours for the fence checks every durable
+        write makes."""
+        self.store_root = os.path.abspath(store_root)
+        self.epoch = store.fence_service_epoch(self.store_root)
+        return self.epoch
+
+    def fenced(self) -> bool:
+        """True once another service instance has claimed this store
+        (the epoch file moved past ours): a promoted standby owns the
+        streams now, so this instance stops persisting, admitting, and
+        flushing verdicts — the new owner's state must win. Sticky:
+        checked against the store on every call until it trips."""
+        if self.store_root is None:
+            return False
+        if self._fenced:  # noqa: JTS201 — monotonic False->True flag
+            return True
+        if store.service_epoch(self.store_root) == self.epoch:
+            return False
+        self._fenced = True
+        log.error("service: fenced out of %s (epoch moved past %d); "
+                  "stopping admissions and durable writes",
+                  self.store_root, self.epoch)
+        with self._lock:
+            self.draining = True
+        self._watch_stop.set()
+        return True
+
+    def recover(self, store_root: str,
+                spec_fn: Callable[[str], dict | None] | None = None
+                ) -> list[str]:
+        """Cold-start crash recovery: claim the store (fencing any
+        zombie predecessor), scan it for orphaned in-progress runs — a
+        journal with no delivered verdict — and resume each from its
+        last durable checkpoint. The journal re-feeds from the start
+        (the host-side encoder and blame attribution need the whole
+        client-op feed) while device dispatch skips row-for-row up to
+        the checkpoint's recorded offset, so the resumed verdict is
+        byte-identical to an uninterrupted run's. Runs with no (or a
+        corrupt) manifest re-check cold via ``spec_fn``. No drain
+        manifest required. Returns the recovered stream names."""
+        self.claim_store(store_root)
+        recovered: list[str] = []
+        with _trace.tracer().span("service.recover") as sp:
+            for tname, runs in store.tests(store_root).items():
+                for start, d in runs.items():
+                    if not os.path.exists(
+                            os.path.join(d, "journal.jsonl")):
+                        continue
+                    if os.path.exists(
+                            os.path.join(d, "results.json")) \
+                            or os.path.exists(os.path.join(
+                                d, store.STREAMED_RESULTS_FILE)):
+                        continue
+                    man = store.load_service_resume(d)
+                    if man is not None:
+                        try:
+                            name = self.resume(d)
+                        except AdmissionRefused:
+                            continue
+                        if name is None:
+                            continue
+                        how = ("checkpoint" if any(
+                            "carry" in ck for ck in
+                            (man.get("checkpoints") or {}).values())
+                            else "cold")
+                    elif spec_fn is not None:
+                        spec = spec_fn(d)
+                        if not spec:
+                            continue
+                        name = f"{tname}/{start}"
+                        try:
+                            self.admit(name, spec, store_dir=d)
+                        except AdmissionRefused:
+                            continue
+                        self._tail_run(d, name)
+                        how = "cold"
+                    else:
+                        continue
+                    _M_RECOVERIES.labels(how=how).inc()
+                    recovered.append(name)
+            if sp is not None:
+                sp.tags["streams"] = str(len(recovered))
+                sp.tags["epoch"] = str(self.epoch)
+        with self._lock:
+            self.recovered_total += len(recovered)
+        if recovered:
+            log.warning("service: recovered %d orphaned stream(s) "
+                        "from %s (epoch %d): %s", len(recovered),
+                        store_root, self.epoch,
+                        ", ".join(sorted(recovered)))
+        return recovered
+
+    # -- the session table (session-resilient wire protocol) ---------------
+
+    def _session_attach(self, stream: str, token: str,
+                        journal_fed: bool) -> "_Session | None":
+        """Register or re-bind a socket session. Returns the session
+        (fresh, or the existing one when the token matches), or None
+        on a token mismatch — a live stream must not be hijackable by
+        name alone."""
+        with self._session_lock:
+            s = self._sessions.get(stream)
+            if s is None:
+                s = self._sessions[stream] = _Session(token,
+                                                      journal_fed)
+                return s
+            if s.token == token:
+                if journal_fed:
+                    s.journal_fed = True
+                return s
+            return None
+
+    def _session_apply(self, stream: str | None, seq) -> bool:
+        """Should this op be applied? False for a replayed duplicate
+        (already applied before the disconnect — counted, dropped) and
+        for journal-fed streams (the store tail feeds those). Ops
+        without a seq are legacy clients: always applied."""
+        if stream is None:
+            return False
+        if seq is None:
+            with self._session_lock:
+                s = self._sessions.get(stream)
+                return not (s and s.journal_fed)
+        try:
+            seq = int(seq)
+        except (TypeError, ValueError):
+            return False
+        with self._session_lock:
+            s = self._sessions.get(stream)
+            if s is None:
+                return True     # attached without a session handshake
+            if s.journal_fed:
+                return False
+            if seq <= s.seq:
+                s.replays += 1
+                _M_REPLAYS.inc()
+                return False
+            s.seq = seq
+            return True
+
+    def _session_ack(self, stream: str | None) -> int:
+        """The stream's applied-sequence high-water mark — everything
+        at or below it is safe for the client to forget."""
+        with self._session_lock:
+            s = self._sessions.get(stream) if stream else None
+            return s.seq if s else 0
+
+    def _session_journal_fed(self, stream: str | None) -> bool:
+        with self._session_lock:
+            s = self._sessions.get(stream) if stream else None
+            return bool(s and s.journal_fed)
+
+    def _prune_sessions(self) -> None:
+        """Bound the session table: entries whose stream left the
+        worker table are dead (no client can re-attach them onto a
+        live worker). Locks taken sequentially, never nested."""
+        with self._lock:
+            live = set(self.workers)
+        with self._session_lock:
+            if len(self._sessions) <= max(256, 4 * self.keep_done):
+                return
+            for n in [n for n in self._sessions if n not in live]:
+                del self._sessions[n]
 
     # -- store watching ----------------------------------------------------
 
@@ -1514,6 +1824,12 @@ class VerificationService:
         with self._lock:
             self._tails[run_dir] = (store.JournalTail(jp), name)
         self._ensure_watcher()
+
+    def _stream_tailed(self, name: str) -> bool:
+        """Is this stream fed from a store-side journal tail (resume /
+        recover / watch) rather than by its socket?"""
+        with self._lock:
+            return any(n == name for _t, n in self._tails.values())
 
     def _scan(self) -> None:
         base = getattr(self, "_watch_base", None)
@@ -1615,6 +1931,11 @@ class VerificationService:
             draining = self.draining
             admitted, refused = self.admitted_total, self.refused_total
             transitions = self.ladder_transitions_total
+            recovered = self.recovered_total
+        with self._session_lock:
+            sessions = len(self._sessions)
+            replays = sum(s.replays
+                          for s in self._sessions.values())
         tiers = dict.fromkeys(TIER_NAMES, 0)
         for w in workers.values():
             if not w.done.is_set():
@@ -1626,6 +1947,10 @@ class VerificationService:
             "streams": {n: w.status() for n, w in workers.items()},
             "admitted-total": admitted,
             "refused-total": refused,
+            "recovered-total": recovered,
+            "epoch": self.epoch,
+            "fenced": self._fenced,  # noqa: JTS201 — monotonic flag
+            "sessions": {"count": sessions, "replays": replays},
             "shed": sorted(n for n, w in workers.items()
                            if w.state == SHED),
             "quarantined": sorted(n for n, w in workers.items()
@@ -1710,8 +2035,16 @@ class VerificationService:
                 conn.sendall(data)
 
         try:
-            with conn, conn.makefile("r", encoding="utf-8") as rf:
-                for line in rf:
+            with conn:
+                for line in _recv_lines(conn):
+                    if line is None:
+                        # oversized frame: the reader skipped it;
+                        # answer and keep the connection alive
+                        reply({"ok": False,
+                               "error": "line too long "
+                                        f"(max {MAX_LINE_BYTES})"},
+                              None)
+                        continue
                     line = line.strip()
                     if not line:
                         continue
@@ -1721,26 +2054,27 @@ class VerificationService:
                         reply({"ok": False,
                                "error": "bad json"}, None)
                         continue
+                    if not isinstance(msg, dict):
+                        reply({"ok": False,
+                               "error": "not an object"}, None)
+                        continue
                     rid = msg.get("id")
                     typ = msg.get("type")
                     t_verb = _time.monotonic()
                     try:
                         if typ == "op":
                             if stream is not None:
-                                self.offer(stream, msg.get("op") or {})
+                                if self._session_apply(
+                                        stream, msg.get("seq")):
+                                    self.offer(stream,
+                                               msg.get("op") or {})
+                                if rid is not None or msg.get("ack"):
+                                    reply({"ok": True,
+                                           "acked": self._session_ack(
+                                               stream)}, rid)
                         elif typ == "attach":
-                            try:
-                                w = self.admit(
-                                    str(msg.get("stream")),
-                                    msg.get("targets") or {},
-                                    store_dir=msg.get("store-dir"))
-                                stream = w.name
-                                reply({"ok": True, "stream": stream,
-                                       "targets": sorted(w.targets)},
-                                      rid)
-                            except (AdmissionRefused, ValueError) as e:
-                                reply({"ok": False, "deferred": True,
-                                       "error": str(e)}, rid)
+                            stream = self._attach_verb(msg, stream,
+                                                       reply, rid)
                         elif typ == "poll":
                             w = self._worker(stream)
                             reply({"ok": True,
@@ -1752,7 +2086,12 @@ class VerificationService:
                                 reply({"ok": False,
                                        "error": "not attached"}, rid)
                                 continue
-                            self.seal(stream)
+                            if not self._session_journal_fed(stream):
+                                # a journal-fed stream seals when its
+                                # journal drains (watch loop), not on
+                                # the client's say-so — sealing here
+                                # would cut the verdict short
+                                self.seal(stream)
                             w = self._worker(stream)
                             timeout = float(msg.get("timeout-s")
                                             or 600.0)
@@ -1776,13 +2115,26 @@ class VerificationService:
                             if stream is not None:
                                 w = self._worker(stream)
                                 if w is not None \
-                                        and not w.done.is_set():
+                                        and not w.done.is_set() \
+                                        and not self._stream_tailed(
+                                            stream):
                                     w.q.put(_CLOSE)
                             return
                         else:
                             reply({"ok": False,
                                    "error": f"unknown type {typ!r}"},
                                   rid)
+                    except OSError:
+                        raise   # the peer is gone; drop below
+                    except Exception as e:  # noqa: BLE001 — a garbage
+                        # frame (or a verb-handler bug) must kill
+                        # neither this connection nor its thread;
+                        # siblings on other sockets feel nothing
+                        log.warning("service: verb %r failed",
+                                    typ, exc_info=True)
+                        reply({"ok": False,
+                               "error": f"{type(e).__name__}: {e}"},
+                              rid)
                     finally:
                         _M_VERB.labels(
                             verb=(typ if typ in _KNOWN_VERBS
@@ -1791,6 +2143,88 @@ class VerificationService:
         except (OSError, ValueError):
             log.info("service: connection dropped%s",
                      f" (stream {stream})" if stream else "")
+
+    def _attach_verb(self, msg: dict, stream: str | None,
+                     reply, rid) -> str | None:
+        """The attach verb: fresh admission, or — when the named
+        worker already exists and the client presents a session token
+        — a session re-attach (socket drop, service restart, or
+        standby failover) that acks the high-water mark so the client
+        replays only unacked ops."""
+        name = str(msg.get("stream"))
+        token = msg.get("session")
+        w = self._worker(name)
+        if token is not None and w is not None:
+            journal_fed = self._stream_tailed(name)
+            s = self._session_attach(name, str(token), journal_fed)
+            if s is None:
+                reply({"ok": False,
+                       "error": "session token mismatch"}, rid)
+                return stream
+            _M_RECONNECTS.labels(side="server").inc()
+            reply({"ok": True, "stream": name, "resumed": True,
+                   "acked": self._session_ack(name),
+                   "journal-fed": self._session_journal_fed(name),
+                   "targets": w.target_names}, rid)
+            return name
+        if msg.get("resume") and w is None:
+            # the stream's acked ops died with its worker and no
+            # recovered worker took over (no journal on the store
+            # side): re-admitting fresh would silently lose them
+            reply({"ok": False, "deferred": True,
+                   "error": "unknown session: stream not recovered"},
+                  rid)
+            return stream
+        try:
+            w = self.admit(name, msg.get("targets") or {},
+                           store_dir=msg.get("store-dir"))
+            if token is not None:
+                self._session_attach(w.name, str(token), False)
+            reply({"ok": True, "stream": w.name,
+                   "targets": sorted(w.targets)}, rid)
+            return w.name
+        except (AdmissionRefused, ValueError) as e:
+            reply({"ok": False, "deferred": True,
+                   "error": str(e)}, rid)
+            return stream
+
+
+def _recv_lines(conn: _socket.socket):
+    """Bounded line reader for the socket protocol: yields one decoded
+    line per frame, or None for a frame that blew past MAX_LINE_BYTES
+    (the rest of that line is discarded, the connection survives).
+    Undecodable bytes are replaced, not fatal — the json parse then
+    rejects the frame with an error reply instead of the decode
+    exception killing the reader thread."""
+    buf = bytearray()
+    skipping = False
+    while True:
+        try:
+            data = conn.recv(65536)
+        except OSError:
+            return
+        if not data:
+            return
+        buf += data
+        while True:
+            nl = buf.find(b"\n")
+            if nl < 0:
+                break
+            line, buf = buf[:nl], buf[nl + 1:]
+            if skipping:        # tail of an oversized frame
+                skipping = False
+                continue
+            if len(line) > MAX_LINE_BYTES:
+                # the whole line arrived before the growth check below
+                # could trip — still an oversized frame
+                yield None
+                continue
+            yield line.decode("utf-8", errors="replace")
+        if len(buf) > MAX_LINE_BYTES:
+            buf.clear()
+            if not skipping:    # complain once per oversized frame
+                skipping = True
+                yield None
 
 
 def _is_unix_addr(addr: str) -> bool:
@@ -1804,14 +2238,35 @@ def _is_unix_addr(addr: str) -> bool:
 POLL_INTERVAL_S = 0.2
 
 
+class _ClientConn:
+    """One live socket — the unit `reconnect.Wrapper` opens and
+    closes; its reader thread exits when the socket does."""
+
+    __slots__ = ("sock",)
+
+    def __init__(self, sock: _socket.socket):
+        self.sock = sock
+
+
 class ServiceClient:
     """An `OnlineChecker`-shaped proxy that feeds a remote
     verification service instead of spawning in-process stream
     workers: same offer/should_abort/finalize/close surface, so
-    core.run and the interpreter cannot tell the difference."""
+    core.run and the interpreter cannot tell the difference.
+
+    Session resilience: ops carry monotonic sequence numbers and stay
+    buffered until the server acks its applied high-water mark; the
+    socket lives inside a `reconnect.Wrapper`, so any disconnect
+    transparently re-attaches — same session token, decorrelated-
+    jitter backoff across the whole address list (`addr` may be
+    comma-separated ``primary,standby``) — and replays only unacked
+    ops. At-least-once delivery, exactly-once application: the
+    server's session table drops replayed duplicates."""
 
     def __init__(self, addr: str, test: dict, spec: dict | None = None):
-        self.addr = addr
+        self.addrs = [a.strip() for a in str(addr).split(",")
+                      if a.strip()]
+        self.addr = self.addrs[0] if self.addrs else str(addr)
         self.targets = spec if spec is not None else targets_spec(test)
         if not self.targets:
             raise ValueError("no streamable checker targets")
@@ -1819,41 +2274,166 @@ class ServiceClient:
         self.aborted = False
         self.stream = "%s/%s" % (test.get("name", "run"),
                                  test.get("start-time", os.getpid()))
-        self._sock = _connect(addr)
-        self._rf = self._sock.makefile("r", encoding="utf-8")
+        self.session = os.urandom(8).hex()
+        store_dir = (store.dir_name(test)
+                     if test.get("name") and test.get("start-time")
+                     else None)
+        self._store_dir = (os.path.abspath(store_dir)
+                           if store_dir else None)
         self._wlock = threading.Lock()
         self._rid = 0                       # guarded-by: _reply_evt
         self._replies: dict[int, dict] = {}  # guarded-by: _reply_evt
         self._reply_evt = threading.Condition()
         self._closed = False                # guarded-by: _reply_evt
         self._last_poll = 0.0
-        self._reader = threading.Thread(
-            target=self._read_loop, name="jepsen-service-client",
-            daemon=True)
-        self._reader.start()
-        store_dir = (store.dir_name(test)
-                     if test.get("name") and test.get("start-time")
-                     else None)
-        r = self._request({"type": "attach", "stream": self.stream,
-                           "targets": self.targets,
-                           "store-dir": (os.path.abspath(store_dir)
-                                         if store_dir else None)},
-                          timeout_s=30.0)
-        if not (r and r.get("ok")):
-            self.close()
-            raise AdmissionRefused(
-                (r or {}).get("error") or "attach failed")
+        # -- the replay buffer (the client half of the session
+        # protocol). _seq is the offering thread's alone.
+        self._seq = 0
+        self._buf_lock = threading.Lock()
+        self._unacked: deque = deque()      # guarded-by: _buf_lock
+        self._acked = 0                     # guarded-by: _buf_lock
+        # monotonic False->True flags, read lock-free on hot paths
+        # (the offer-path noqa discipline): flipped under the
+        # wrapper's write lock by the reopen handshake
+        self._journal_fed = False
+        self._attached = False
+        self._dead = False
+        self._active: str | None = None     # addr currently attached
+        self.reconnects = 0
+        self.failovers = 0
+        self._wrap = _reconnect.Wrapper(
+            self._open_conn, self._close_conn, log=log.info,
+            name=f"verification service {self.addr}")
+        self._wrap.open()   # first attach; raises on refusal
         log.info("attached to verification service %s as %r "
-                 "(targets %s)", addr, self.stream,
-                 sorted(self.targets))
+                 "(targets %s, session %s)", self._active,
+                 self.stream, sorted(self.targets), self.session)
 
     # -- wire --------------------------------------------------------------
+
+    def _open_conn(self) -> _ClientConn:
+        """Open + attach one connection, cycling the address list
+        under decorrelated-jitter backoff. Landing on a different
+        address than last time is a client-side failover."""
+        delays = None
+        err: Exception | None = None
+        for attempt in range(RECONNECT_TRIES):
+            if attempt:
+                if delays is None:
+                    from .control.retry import backoff
+                    delays = backoff(0.05, 2.0)
+                _time.sleep(next(delays))
+            for a in self.addrs:
+                try:
+                    sock = _connect(a)
+                except OSError as e:
+                    err = e
+                    continue
+                try:
+                    conn = self._handshake(sock, a)
+                except AdmissionRefused:
+                    # authoritative refusal: retrying cannot help
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    self._dead = True
+                    raise
+                except (OSError, ValueError) as e:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    err = e
+                    continue
+                if self._active is not None and a != self._active:
+                    self.failovers += 1
+                    _M_FAILOVERS.labels(role="client").inc()
+                    log.warning("service client %r: failed over "
+                                "%s -> %s", self.stream,
+                                self._active, a)
+                self._active = a
+                return conn
+        self._dead = True
+        raise (err if err is not None else
+               OSError(f"no verification service reachable "
+                       f"at {self.addrs}"))
+
+    def _handshake(self, sock: _socket.socket,
+                   addr: str) -> _ClientConn:
+        """Attach on a fresh socket: present the session token, learn
+        the server's acked high-water mark, prune the replay buffer
+        to it, and re-send whatever the dead connection lost."""
+        resume = self._attached
+        with self._reply_evt:
+            self._rid += 1
+            rid = self._rid
+        req = {"type": "attach", "stream": self.stream,
+               "targets": self.targets, "store-dir": self._store_dir,
+               "session": self.session, "resume": resume, "id": rid}
+        sock.settimeout(30.0)   # the handshake exchange only
+        sock.sendall((json.dumps(req, default=store._json_default)
+                      + "\n").encode())
+        rf = sock.makefile("r", encoding="utf-8")
+        r = None
+        while not (isinstance(r, dict) and r.get("id") == rid):
+            line = rf.readline()
+            if not line:
+                raise OSError("connection lost during attach")
+            try:
+                r = json.loads(line)
+            except ValueError:
+                r = None
+        if not r.get("ok"):
+            raise AdmissionRefused(r.get("error") or "attach failed")
+        if r.get("journal-fed"):
+            # a recovered (or promoted-standby) service tails this
+            # run's journal directly: socket ops would double-apply,
+            # so the socket feed stops here
+            self._journal_fed = True
+        acked = int(r.get("acked") or 0)
+        with self._buf_lock:
+            self._acked = max(self._acked, acked)
+            while self._unacked \
+                    and self._unacked[0][0] <= self._acked:
+                self._unacked.popleft()
+            if self._journal_fed:
+                self._unacked.clear()
+            replay = list(self._unacked)
+        for seq, op in replay:
+            sock.sendall((json.dumps(
+                {"type": "op", "op": op, "seq": seq},
+                default=store._json_default) + "\n").encode())
+        sock.settimeout(None)
+        self._attached = True
+        if resume:
+            self.reconnects += 1
+            _M_RECONNECTS.labels(side="client").inc()
+            log.info("service client %r: re-attached to %s "
+                     "(acked %d, replayed %d unacked ops%s)",
+                     self.stream, addr, acked, len(replay),
+                     "; journal-fed" if self._journal_fed else "")
+        threading.Thread(target=self._read_loop, args=(rf,),
+                         name="jepsen-service-client",
+                         daemon=True).start()
+        return _ClientConn(sock)
+
+    def _close_conn(self, conn: _ClientConn) -> None:
+        try:
+            conn.sock.close()   # the reader exits with the socket
+        except OSError:
+            pass
 
     def _send(self, msg: dict) -> None:
         data = (json.dumps(msg, default=store._json_default)
                 + "\n").encode()
-        with self._wlock:
-            self._sock.sendall(data)
+
+        def _do(conn: _ClientConn) -> None:
+            with self._wlock:
+                conn.sock.sendall(data)
+        # with_conn reopens (re-attach + replay) on failure and
+        # re-raises; the callers decide whether that loses anything
+        self._wrap.with_conn(_do)
 
     def _request(self, msg: dict,
                  timeout_s: float = 30.0) -> dict | None:
@@ -1861,7 +2441,10 @@ class ServiceClient:
             self._rid += 1
             rid = self._rid
         msg["id"] = rid
-        self._send(msg)
+        try:
+            self._send(msg)
+        except (OSError, ValueError):
+            return None
         deadline = _time.monotonic() + timeout_s
         with self._reply_evt:
             while rid not in self._replies:
@@ -1871,9 +2454,21 @@ class ServiceClient:
                 self._reply_evt.wait(wait)
             return self._replies.pop(rid)
 
-    def _read_loop(self) -> None:
+    def _note_acked(self, acked) -> None:
         try:
-            for line in self._rf:
+            acked = int(acked)
+        except (TypeError, ValueError):
+            return
+        with self._buf_lock:
+            if acked > self._acked:
+                self._acked = acked
+            while self._unacked \
+                    and self._unacked[0][0] <= self._acked:
+                self._unacked.popleft()
+
+    def _read_loop(self, rf) -> None:
+        try:
+            for line in rf:
                 line = line.strip()
                 if not line:
                     continue
@@ -1881,32 +2476,54 @@ class ServiceClient:
                     msg = json.loads(line)
                 except ValueError:
                     continue
+                if not isinstance(msg, dict):
+                    continue
+                if "acked" in msg:
+                    self._note_acked(msg.get("acked"))
                 rid = msg.get("id")
-                if rid is not None:
-                    with self._reply_evt:
-                        self._replies[int(rid)] = msg
-                        self._reply_evt.notify_all()
+                if rid is None:
+                    continue
+                try:
+                    rid = int(rid)
+                except (TypeError, ValueError):
+                    continue
+                with self._reply_evt:
+                    self._replies[rid] = msg
+                    self._reply_evt.notify_all()
         except (OSError, ValueError):
             pass
+        # this socket died, but the wrapper may reopen it under a new
+        # reader: wake waiters so requests notice promptly — only
+        # _mark_closed flips _closed
         with self._reply_evt:
-            self._closed = True
             self._reply_evt.notify_all()
 
     # -- OnlineChecker surface ---------------------------------------------
 
     def offer(self, op: dict) -> None:
-        # lock-free read by design: _closed is a monotonic flag, and
-        # the op hot path must not take the reply lock per op
-        if self._closed:  # noqa: JTS201
+        # lock-free reads by design: monotonic flags, and the op hot
+        # path must not take the reply lock per op
+        if self._closed or self._journal_fed:  # noqa: JTS201
             return
+        self._seq += 1
+        seq = self._seq
+        with self._buf_lock:
+            self._unacked.append((seq, op))
+        msg = {"type": "op", "op": op, "seq": seq}
+        if seq % ACK_EVERY == 0:
+            msg["ack"] = True   # bound the replay buffer
         try:
-            self._send({"type": "op", "op": op})
+            self._send(msg)
         except OSError:
-            # the service died mid-run: the journal still has
-            # everything; offline checking covers
-            log.warning("verification service connection lost; "
-                        "offline checking will cover this run")
-            self._mark_closed()
+            # the send failed, but with_conn already re-attached and
+            # replayed the buffer — this op included, it was appended
+            # before the send. Only a reopen that itself gave up
+            # (dead) or was refused ends the session.
+            if self._dead:  # noqa: JTS201
+                log.warning("verification service connection lost "
+                            "and not recoverable; offline checking "
+                            "will cover this run")
+                self._mark_closed()
 
     def should_abort(self) -> bool:
         if self.aborted:
@@ -1929,9 +2546,23 @@ class ServiceClient:
         {}, so offline checking covers them)."""
         if self._closed:  # noqa: JTS201 — monotonic-flag fast path
             return {}
+        if self._journal_fed:  # noqa: JTS201
+            # the recovered service tails the journal and writes
+            # streamed results into the run dir itself; analyze
+            # reuses them — nothing to collect over this socket
+            self._mark_closed()
+            return {}
         r = self._request({"type": "finish",
                            "timeout-s": timeout_s},
                           timeout_s=(timeout_s or 600.0) + 30.0)
+        if r is None and not self._dead \
+                and not self._journal_fed:  # noqa: JTS201
+            # the reply (or its socket) was lost mid-wait: finish is
+            # idempotent under the session protocol, so ask once more
+            # on the reopened connection
+            r = self._request({"type": "finish",
+                               "timeout-s": timeout_s},
+                              timeout_s=(timeout_s or 600.0) + 30.0)
         self._mark_closed()
         if not (r and r.get("ok")):
             log.warning("verification service finish failed; offline "
@@ -1960,8 +2591,8 @@ class ServiceClient:
             self._closed = True
             self._reply_evt.notify_all()
         try:
-            self._sock.close()
-        except OSError:
+            self._wrap.close()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
             pass
 
 
@@ -2003,3 +2634,88 @@ def maybe_attach(test: dict):
         log.warning("verification service %s unreachable (%s); "
                     "falling back to local checking", addr, e)
         return None
+
+
+# ---------------------------------------------------------------------------
+# replica failover (the --standby mode)
+# ---------------------------------------------------------------------------
+
+class Standby:
+    """A warm replica: watch a primary's health endpoint, and after
+    ``failures`` consecutive failed probes fence the (presumed dead)
+    primary via the store-level epoch file, `recover()` every
+    orphaned stream from its durable checkpoints, and begin serving.
+    The fence makes promotion safe against false positives: a merely
+    partitioned primary notices the epoch moved past its own at its
+    next durable write and stops touching the store (doc/robustness.md
+    has the state machine)."""
+
+    def __init__(self, svc: VerificationService, primary: str,
+                 store_root: str, bind: str = "127.0.0.1:0",
+                 poll_s: float = DEFAULT_STANDBY_POLL_S,
+                 failures: int = DEFAULT_STANDBY_FAILURES,
+                 spec_fn: Callable[[str], dict | None] | None = None):
+        self.svc = svc
+        self.primary = primary
+        self.store_root = store_root
+        self.bind = bind
+        self.poll_s = float(poll_s)
+        self.failures = int(failures)
+        self.spec_fn = spec_fn
+        self.promoted = threading.Event()
+        self.bound: str | None = None
+        self._stop = threading.Event()
+
+    def healthy(self) -> bool:
+        """One probe of the primary: its /healthz when given an
+        http(s) URL, else the socket ``status`` verb."""
+        try:
+            if self.primary.startswith(("http://", "https://")):
+                from urllib.request import urlopen
+                with urlopen(self.primary.rstrip("/") + "/healthz",
+                             timeout=5.0) as resp:
+                    return 200 <= resp.status < 300
+            sock = _connect(self.primary)
+            try:
+                sock.settimeout(5.0)
+                sock.sendall(b'{"type": "poll", "id": 0}\n')
+                return bool(sock.recv(1))
+            finally:
+                sock.close()
+        except (OSError, ValueError):
+            return False
+
+    def run(self) -> str | None:
+        """Block watching the primary; on sustained failure promote
+        and return the bound serve address (None if stop()ped
+        first)."""
+        log.info("standby: watching primary %s (probe every %.1fs, "
+                 "promote after %d failures)", self.primary,
+                 self.poll_s, self.failures)
+        failed = 0
+        while not self._stop.is_set():
+            failed = 0 if self.healthy() else failed + 1
+            if failed >= self.failures:
+                return self.promote()
+            self._stop.wait(self.poll_s)
+        return None
+
+    def promote(self) -> str:
+        """Fence the primary, recover its streams, start serving."""
+        log.warning("standby: primary %s unhealthy for %d probes — "
+                    "fencing and promoting", self.primary,
+                    self.failures)
+        recovered = self.svc.recover(self.store_root,
+                                     spec_fn=self.spec_fn)
+        # keep admitting fresh runs appearing under the store too
+        self.svc.watch(self.store_root, spec_fn=self.spec_fn)
+        self.bound = self.svc.serve(self.bind)
+        _M_FAILOVERS.labels(role="standby").inc()
+        log.warning("standby: promoted — serving on %s (%d streams "
+                    "recovered, epoch %d)", self.bound,
+                    len(recovered), self.svc.epoch)
+        self.promoted.set()
+        return self.bound
+
+    def stop(self) -> None:
+        self._stop.set()

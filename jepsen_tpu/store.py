@@ -16,6 +16,7 @@ import json
 import logging
 import os
 import threading as _threading
+import zipfile
 from typing import Any, Callable, Iterable
 
 from .history import INFO, NEMESIS, History, history
@@ -400,48 +401,106 @@ def load_streamed_results(run_dir: str) -> dict | None:
 
 
 def write_service_resume(run_dir: str, manifest: dict) -> str:
-    """Persist a draining service's resume manifest for one run.
-    Checkpoint entries under manifest['checkpoints'] may carry a
-    'carry' list of arrays; they are split out into .npz files next
-    to resume.json (JSON-ing device carries would be both huge and
-    lossy) and rejoined by load_service_resume."""
+    """Persist a service's resume manifest for one run — at drain,
+    and periodically at every carry-checkpoint cycle, so a SIGKILL'd
+    daemon recovers from its last durable checkpoint. Checkpoint
+    entries under manifest['checkpoints'] may carry a 'carry' list of
+    arrays; they are split out into .npz files next to resume.json
+    (JSON-ing device carries would be both huge and lossy) and
+    rejoined by load_service_resume.
+
+    Atomicity (the calibrate.py idiom, multiplied out for the
+    json+npz pair): each carry file is written under a pid-unique tmp
+    name and renamed into a chunk-versioned final name, and
+    resume.json — which references the carries by those versioned
+    names — is tmp-then-renamed LAST. A crash at any point leaves
+    either the previous consistent (json, npz) pair or the new one,
+    never a manifest pointing at a half-written carry. Stale carry
+    versions are pruned only after the manifest lands."""
     import numpy as np
     d = _service_dir(run_dir)
     os.makedirs(d, exist_ok=True)
     man = dict(manifest)
     cks = {}
+    fresh: set[str] = set()
     for target, ck in (manifest.get("checkpoints") or {}).items():
         ck = dict(ck)
         carry = ck.pop("carry", None)
         if carry is not None:
-            fn = f"{str(target).replace(os.sep, '_')}.carry.npz"
-            np.savez(os.path.join(d, fn),
-                     *[np.asarray(a) for a in carry])
+            safe = str(target).replace(os.sep, "_")
+            fn = f"{safe}.carry.c{int(ck.get('chunks', 0))}.npz"
+            tmp = os.path.join(d, f"{fn}.{os.getpid()}.tmp")
+            with open(tmp, "wb") as fh:
+                np.savez(fh, *[np.asarray(a) for a in carry])
+            os.replace(tmp, os.path.join(d, fn))
             ck["carry-file"] = fn
+            fresh.add(fn)
         cks[target] = ck
     man["checkpoints"] = cks
     p = os.path.join(d, "resume.json")
-    with open(p, "w") as fh:
+    tmp = f"{p}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
         json.dump(man, fh, indent=2, default=_json_default)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, p)
+    for fn in os.listdir(d):
+        # superseded carry versions and orphaned tmps from a crashed
+        # writer; the manifest's own references were just renamed in
+        if (".carry." in fn or fn.endswith(".tmp")) \
+                and fn not in fresh:
+            try:
+                os.unlink(os.path.join(d, fn))
+            except OSError:
+                pass
     return p
 
 
 def load_service_resume(run_dir: str) -> dict | None:
     """The resume manifest for a run, with carry arrays rejoined, or
-    None when no service ever drained here."""
+    None when no service ever checkpointed here. Mirrors
+    calibrate.Calibration.load's posture on damage: a corrupt or
+    truncated resume.json returns None (the stream re-checks cold
+    from its journal), and a corrupt/missing carry .npz drops only
+    that target's checkpoint — a bad file must never stop the
+    daemon."""
     import numpy as np
     p = os.path.join(_service_dir(run_dir), "resume.json")
     if not os.path.exists(p):
         return None
-    with open(p) as fh:
-        man = json.load(fh)
-    for target, ck in (man.get("checkpoints") or {}).items():
+    try:
+        with open(p) as fh:
+            man = json.load(fh)
+        if not isinstance(man, dict):
+            raise ValueError(f"expected a json object, got "
+                             f"{type(man).__name__}")
+    except (OSError, ValueError) as e:
+        log.warning("%s: corrupt resume manifest (%s); the stream "
+                    "will re-check cold from its journal", p, e)
+        return None
+    cks = man.get("checkpoints")
+    if not isinstance(cks, dict):
+        man["checkpoints"] = {}
+        return man
+    for target in list(cks):
+        ck = cks[target]
+        if not isinstance(ck, dict):
+            del cks[target]
+            continue
         fn = ck.pop("carry-file", None)
-        if fn:
-            with np.load(os.path.join(_service_dir(run_dir), fn)) as z:
+        if not fn:
+            continue
+        try:
+            with np.load(os.path.join(_service_dir(run_dir),
+                                      os.path.basename(fn))) as z:
                 ck["carry"] = [
                     z[k] for k in sorted(
                         z.files, key=lambda s: int(s.split("_")[-1]))]
+        except (OSError, ValueError, EOFError,
+                zipfile.BadZipFile) as e:
+            log.warning("%s: corrupt carry checkpoint %s for %r (%s);"
+                        " that target resumes cold", p, fn, target, e)
+            del cks[target]
     return man
 
 
@@ -452,6 +511,44 @@ def clear_service_resume(run_dir: str) -> None:
     d = _service_dir(run_dir)
     if os.path.isdir(d):
         shutil.rmtree(d, ignore_errors=True)
+
+
+# -- store-level service epoch (replica fencing) ----------------------------
+#
+# One monotonic integer per store root, bumped by every service
+# instance that takes ownership of the store (cold-start recovery, or
+# a standby promoting over a dead primary). A fenced-out instance —
+# one whose claimed epoch no longer matches the file — must stop
+# persisting checkpoints and verdicts: the classic split-brain guard,
+# so a zombie primary cannot clobber the new owner's state.
+
+SERVICE_EPOCH_FILE = "service.epoch"
+
+
+def service_epoch(base: str) -> int:
+    """The store's current service epoch (0 when never claimed; a
+    corrupt epoch file reads as 0 — claiming bumps past it)."""
+    try:
+        with open(os.path.join(base, SERVICE_EPOCH_FILE)) as fh:
+            return int(fh.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def fence_service_epoch(base: str) -> int:
+    """Bump the store's service epoch (atomic tmp-then-rename) and
+    return the new value — the caller now owns the store, and any
+    instance still holding the previous epoch is fenced."""
+    os.makedirs(base, exist_ok=True)
+    epoch = service_epoch(base) + 1
+    p = os.path.join(base, SERVICE_EPOCH_FILE)
+    tmp = f"{p}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write(f"{epoch}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, p)
+    return epoch
 
 
 def write_results(test, results: dict) -> str:
