@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint staticcheck test tier0 tier1 check
+.PHONY: lint staticcheck test tier0 tier1 check chaos-smoke chaos-soak
 
 # the full static gate: style/imports + metric naming + device-sync
 # (JTS1xx) + lock discipline (JTS2xx) + retrace hazards (JTS3xx) on
@@ -35,12 +35,16 @@ test:
 # asserts recover() reproduces the solo verdicts byte-for-byte — the
 # crash-consistency contract gates here even though the test carries
 # the slow marker (tier1 filters it out; tier0 names it explicitly).
+# The chaos line runs the harness unit tests plus the pinned
+# guided-vs-random A/B (slow-marked, named here like the sigkill
+# smoke); the corrupt-manifest recover pin stays in the slow tier.
 tier0: staticcheck
 	$(PY) -m pytest tests/test_screen.py tests/test_attest.py \
 		tests/test_telemetry.py tests/test_staticcheck.py \
 		tests/test_adaptive.py -q
 	$(PY) -m pytest tests/test_search.py -q \
 		-k 'not ab_demo and not service_escalation'
+	$(PY) -m pytest tests/test_chaos.py -q -k 'not corrupt_manifest'
 	$(PY) -m pytest tests/test_service_crash.py -q -k 'sigkill'
 
 # the driver's tier-1 gate: everything not marked slow (the slow tier
@@ -55,4 +59,22 @@ tier0: staticcheck
 tier1:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 
-check: lint test
+# tier-0 self-chaos gate: 20 guided fault schedules against the live
+# pipeline on CPU, every run held to the five oracles (verdict
+# byte-identity vs an uninjected solo, violation-missed, watchdog,
+# resource-leak, stamp-consistency) — doc/robustness.md `Self-chaos`.
+# Exits non-zero if any oracle fires; a found failure is shrunk to a
+# minimal schedule and printed in the JSON result.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.cli chaos \
+		--budget 20 --ops 128 --seed 23
+
+# open-ended soak: a long guided campaign with a generous deadline —
+# run overnight (or on real hardware, where the recovery rungs hit
+# actual device resets) and keep the chaos.json/coverage.bin corpus.
+chaos-soak:
+	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.cli chaos \
+		--budget 400 --ops 256 --seed 45100 --deadline-s 600 \
+		--store-dir scratch/chaos-soak
+
+check: lint test chaos-smoke

@@ -1336,6 +1336,41 @@ def section_search():
     return out
 
 
+def section_chaos():
+    """Self-chaos A/B, CPU-pinned (doc/robustness.md, "Self-chaos"):
+    coverage-guided vs pure-random fault-schedule fuzzing of the
+    verification pipeline — same seed universe, same schedule budget.
+    The prize is the fault-DURING-recovery-replay conjunction (a
+    second fault landing inside the replay window of the first):
+    reports conjunction hits per strategy, corpus coverage, schedule
+    throughput, and that every oracle stayed green on the clean
+    tree."""
+    from jepsen_tpu.chaos import ChaosConfig, run_chaos
+
+    out: dict = {}
+    for strategy in ("guided", "random"):
+        t0 = time.monotonic()
+        r = run_chaos(ChaosConfig(
+            strategy=strategy, workload="register",
+            budget=40, ops=128, seed=23))
+        out[strategy] = {
+            "schedules": r["schedules"],
+            "conjunction_hits": r["conjunction-hits"],
+            "coverage_bits": r["coverage-bits"],
+            "corpus_genomes": r["corpus-size"],
+            "oracle_failures": len(r["failures"]),
+            "seconds": round(time.monotonic() - t0, 3),
+        }
+        out[strategy]["schedules_per_s"] = round(
+            r["schedules"] / max(1e-9, out[strategy]["seconds"]), 1)
+    out["separation"] = bool(
+        out["guided"]["conjunction_hits"] > 0
+        and out["random"]["conjunction_hits"] == 0)
+    out["oracles_green"] = (out["guided"]["oracle_failures"] == 0
+                            and out["random"]["oracle_failures"] == 0)
+    return out
+
+
 # (name, fn, timeout_s, touches_device).  Budgets are generous: they
 # exist to bound a wedged relay, not to race healthy runs.
 SECTIONS = [
@@ -1356,6 +1391,7 @@ SECTIONS = [
     ("telemetry", section_telemetry, 420, False),
     ("generator", section_generator, 180, False),
     ("search", section_search, 420, False),
+    ("chaos", section_chaos, 420, False),
 ]
 
 # nested-only sections (invoked by other sections, never scheduled by

@@ -23,13 +23,25 @@ Because real faults are hard to produce on demand, the same module
 carries the test-only injection shim: `maybe_inject_fault(site)` is
 called immediately before every recovery-aware device dispatch, and
 either the `JEPSEN_TPU_FAULT_INJECT` env knob (``kind@site:n`` — raise
-an InjectedFault of `kind` at the n-th dispatch on `site`) or the
-monkeypatchable `fault_hook` makes each bucket deterministically
-reproducible in tier-1, on CPU, with no hardware."""
+an InjectedFault of `kind` at the n-th dispatch on `site`), an
+installed :class:`FaultSchedule` (an ORDERED multi-event schedule:
+each event arms only after the previous one fired, so `oom` at chunk
+3 *then* `bitflip` one staging later lands the second fault inside
+the first one's recovery replay), or the monkeypatchable `fault_hook`
+makes each bucket deterministically reproducible in tier-1, on CPU,
+with no hardware.
+
+The chaos harness (jepsen_tpu/chaos/) additionally listens through
+`probe_hook`: the pipeline emits tiny lifecycle/recovery *probe*
+events (replay begin/end, fault absorbed, stream state transitions)
+through :func:`probe`, which is a no-op unless a harness installed a
+hook — production pays one attribute check."""
 
 from __future__ import annotations
 
+import fnmatch
 import os
+import threading
 
 # Fault buckets (classify_backend_error return values). Anything the
 # classifier recognizes as a backend failure but cannot place more
@@ -219,11 +231,157 @@ _fault_seq: dict[str, int] = {}
 _corrupt_seq: dict[str, int] = {}
 
 
+class FaultEvent:
+    """One scheduled fault: raise/flip `kind` at the `after`-th hit on
+    a site matching `site` (fnmatch pattern — ``stream-chunk/*``
+    matches every stream), counted from the moment the event ARMS.
+    The first event arms at install; each later event arms when its
+    predecessor fires — triggers are relative, which is what lets a
+    schedule express "one staging into the recovery replay"."""
+
+    __slots__ = ("kind", "site", "after")
+
+    def __init__(self, kind: str, site: str, after: int = 1):
+        if after < 1:
+            raise ValueError(f"after must be >= 1, got {after}")
+        self.kind = kind
+        self.site = site
+        self.after = int(after)
+
+    def __repr__(self) -> str:
+        return f"{self.kind}@{self.site}:{self.after}"
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "site": self.site,
+                "after": self.after}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultEvent":
+        return cls(d["kind"], d["site"], int(d.get("after", 1)))
+
+
+class FaultSchedule:
+    """An ordered list of FaultEvents, advanced by the injection shim.
+
+    Unlike the env knob's clauses — which all count the SAME absolute
+    per-site counters and therefore cannot say "after the first fault
+    fired" — schedule events arm strictly in order: event i+1 starts
+    counting hits only once event i fired. ``bitflip`` events consume
+    staging hits (maybe_corrupt); every other kind consumes dispatch
+    hits (maybe_inject_fault). Thread-safe: the service pumps streams
+    from many worker threads. `fired` records (kind, site, hit) per
+    fired event for the chaos stamp-consistency oracle."""
+
+    def __init__(self, events):
+        self.events = [e if isinstance(e, FaultEvent)
+                       else FaultEvent.from_dict(e) for e in events]
+        self._lock = threading.Lock()
+        self._i = 0             # guarded-by: _lock
+        self._hits = 0          # hits on the armed event's site
+        self.fired: list = []   # guarded-by: _lock
+
+    @classmethod
+    def from_clauses(cls, clauses) -> "FaultSchedule":
+        """Build from ``kind@site:n`` strings (the env-knob grammar,
+        but ordered: n counts hits after the previous clause fired)."""
+        events = []
+        for clause in clauses:
+            clause = clause.strip()
+            if not clause:
+                continue
+            kind, _, rest = clause.partition("@")
+            site, _, after = rest.partition(":")
+            events.append(FaultEvent(kind, site, int(after or 1)))
+        return cls(events)
+
+    def done(self) -> bool:
+        with self._lock:
+            return self._i >= len(self.events)
+
+    def remaining(self) -> int:
+        with self._lock:
+            return len(self.events) - self._i
+
+    def _advance(self, site: str, staging: bool):
+        """One hit on `site`. Returns the armed event when it fires
+        (caller raises/flips outside the lock), else None."""
+        with self._lock:
+            if self._i >= len(self.events):
+                return None
+            evt = self.events[self._i]
+            if (evt.kind == BITFLIP_KIND) != staging:
+                return None
+            if not fnmatch.fnmatch(site, evt.site):
+                return None
+            self._hits += 1
+            if self._hits < evt.after:
+                return None
+            self._i += 1
+            self._hits = 0
+            self.fired.append((evt.kind, site, evt.after))
+            return evt
+
+    def on_dispatch(self, site: str) -> None:
+        evt = self._advance(site, staging=False)
+        if evt is not None:
+            probe("inject", kind=evt.kind, site=site,
+                  source="schedule")
+            raise InjectedFault(evt.kind, site, evt.after)
+
+    def on_staging(self, site: str, arr):
+        evt = self._advance(site, staging=True)
+        if evt is None:
+            return arr
+        probe("corrupt", kind=evt.kind, site=site, source="schedule")
+        return flip_bit(arr)
+
+
+# the installed schedule, if any (chaos harness / tests only)
+_schedule: FaultSchedule | None = None
+
+
+def install_fault_schedule(
+        schedule: "FaultSchedule | None") -> "FaultSchedule | None":
+    """Install (or clear, with None) the process-wide fault schedule.
+    Returns the previous one. reset_fault_injection() also clears it."""
+    global _schedule
+    prev, _schedule = _schedule, schedule
+    return prev
+
+
+def current_fault_schedule() -> "FaultSchedule | None":
+    return _schedule
+
+
+# -- chaos probes (jepsen_tpu/chaos/ and tests only) ------------------------
+
+# fn(event: dict) -> None; None = probes are free (one attr check)
+probe_hook = None
+
+
+def probe(event: str, **info) -> None:
+    """Emit one chaos probe event ({"event": ..., **info}) to the
+    installed hook. Never raises — a broken harness must not take the
+    pipeline down with it."""
+    hook = probe_hook
+    if hook is None:
+        return
+    d = {"event": event}
+    d.update(info)
+    try:
+        hook(d)
+    except Exception:  # noqa: BLE001 — observability must not break us
+        pass
+
+
 def reset_fault_injection() -> None:
-    """Zero the per-site dispatch/staging counters (each test starts
-    its own deterministic injection schedule)."""
+    """Zero the per-site dispatch/staging counters and drop any
+    installed schedule (each test starts its own deterministic
+    injection schedule)."""
+    global _schedule
     _fault_seq.clear()
     _corrupt_seq.clear()
+    _schedule = None
 
 
 def maybe_inject_fault(site: str) -> None:
@@ -245,6 +403,9 @@ def maybe_inject_fault(site: str) -> None:
     hook = fault_hook
     if hook is not None:
         hook(site)
+    sched = _schedule
+    if sched is not None:
+        sched.on_dispatch(site)
     spec = os.environ.get(FAULT_INJECT_ENV)
     if not spec:
         return
@@ -257,6 +418,7 @@ def maybe_inject_fault(site: str) -> None:
             continue   # silent-corruption clauses act at staging time
         tsite, _, seq = rest.partition(":")
         if tsite == site and n == int(seq or 1):
+            probe("inject", kind=kind, site=site, source="env")
             raise InjectedFault(kind, site, n)
 
 
@@ -281,6 +443,11 @@ def maybe_corrupt(site: str, arr):
         out = hook(site, arr)
         if out is not None:
             return out
+    sched = _schedule
+    if sched is not None:
+        out = sched.on_staging(site, arr)
+        if out is not arr:
+            return out
     spec = os.environ.get(FAULT_INJECT_ENV)
     if not spec:
         return arr
@@ -293,6 +460,7 @@ def maybe_corrupt(site: str, arr):
             continue
         tsite, _, seq = rest.partition(":")
         if tsite == site and n == int(seq or 1):
+            probe("corrupt", kind=kind, site=site, source="env")
             return flip_bit(arr)
     return arr
 

@@ -65,7 +65,8 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 from .._platform import (FAULT_COMPILE, FAULT_DEVICE_LOST, FAULT_OOM,
                          attest_enabled, guarded_device_get,
-                         maybe_corrupt, maybe_inject_fault)
+                         maybe_corrupt, maybe_inject_fault,
+                         probe as _probe)
 from ..history import (KIND_INFO, KIND_OK, NIL, PENDING_RET,
                        DeviceEncodingError, History, OpArray,
                        history as as_history)
@@ -528,7 +529,11 @@ class WglStream:
         spent. Exceptions the classifier rejects — ordinary bugs — are
         re-raised by the trail: they must never trigger recovery."""
         self._last_fault = exc
-        return self._trail.absorb(exc, f"online WGL stream {site}")
+        more = self._trail.absorb(exc, f"online WGL stream {site}")
+        _probe("fault", site=self.fault_site,
+               kind=(self.faults[-1] if self.faults else None),
+               retry=len(self.faults), at=site)
+        return more
 
     def _apply_stream_rung(self, kind: str) -> None:
         """Mutate the stream's knobs per the fault bucket before the
@@ -576,6 +581,12 @@ class WglStream:
             rows0, chunks0 = 0, 0
         self._resumed_from_chunk = chunks0
         self._rows_done = rows0
+        # chaos probe: a fault probe landing between replay-begin and
+        # replay-end is the fault-DURING-replay conjunction the chaos
+        # coverage rewards (no replay-end fires when the replay itself
+        # faults — the harness treats the window as still open)
+        _probe("replay-begin", site=self.fault_site,
+               from_chunk=chunks0)
         # rewind the chunk counter too: the replay loop re-increments
         # it per slice, so it lands back at the live chunk count —
         # otherwise later checkpoints and the violation log would
@@ -618,6 +629,8 @@ class WglStream:
             self._drain_attest()
         if not self._dead:
             self._check_death(self._carry)
+        _probe("replay-end", site=self.fault_site,
+               replayed=len(tail))
         log.info("online WGL stream resumed from chunk %d "
                  "(replayed %d step rows)", chunks0, len(tail))
 

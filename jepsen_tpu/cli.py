@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import pprint as _pprint
 import re
 import sys
@@ -776,6 +777,12 @@ def search_cmd() -> dict:
             print(f"unknown bug {options['bug']!r}; "
                   f"have {sorted(BUGS)}", file=sys.stderr)
             raise SystemExit(254)
+        resume = options.get("resume")
+        if resume and not os.path.exists(
+                os.path.join(resume, "search.json")):
+            print(f"--resume: no search.json under {resume!r}",
+                  file=sys.stderr)
+            raise SystemExit(254)
         cfg = SearchConfig(
             workload=options["workload"],
             generations=options["generations"],
@@ -787,7 +794,8 @@ def search_cmd() -> dict:
             bug=options.get("bug") or None,
             max_sims=options.get("max_sims"),
             sample=options["sample"],
-            store_dir=options.get("store_dir"),
+            store_dir=options.get("store_dir") or resume,
+            resume_dir=resume,
         )
         results = run_search(cfg)
         print(_json.dumps(results, indent=2, sort_keys=True))
@@ -827,16 +835,88 @@ def search_cmd() -> dict:
                 help="Clean-history audit escalation fraction"),
             opt("--store-dir", default=None, metavar="DIR",
                 help="Write search.json + coverage.bin here"),
+            opt("--resume", default=None, metavar="DIR",
+                help="Continue a prior search from its store dir "
+                     "(reloads search.json + coverage.bin; restored "
+                     "simulations keep counting against --max-sims; "
+                     "artifacts are rewritten there unless "
+                     "--store-dir overrides)"),
         ],
         "usage": "Coverage-guided scenario search (doc/search.md)",
         "run": run_search_cmd,
     }}
 
 
+def chaos_cmd() -> dict:
+    """`jepsen-tpu chaos` — self-chaos: coverage-guided fault-schedule
+    fuzzing of the verification pipeline itself (doc/robustness.md,
+    "Self-chaos"). Executes mutated backend-fault + lifecycle
+    schedules against a live VerificationService running a fixed
+    workload and holds every outcome to the chaos oracles; failures
+    shrink to a minimal schedule. Exits 0 when all oracles stayed
+    green, 1 when a failure was found (its minimized schedule is in
+    the output and the --store-dir artifact)."""
+    def run_chaos_cmd(options):
+        import json as _json
+
+        from . import report
+        from .chaos import ChaosConfig, run_chaos
+        from .chaos.driver import WORKLOADS
+
+        if options.get("workload") not in WORKLOADS:
+            print(f"unknown workload {options.get('workload')!r}; "
+                  f"have {sorted(WORKLOADS)}", file=sys.stderr)
+            raise SystemExit(254)
+        cfg = ChaosConfig(
+            workload=options["workload"],
+            ops=options["ops"],
+            budget=options["budget"],
+            seed=options["seed"],
+            strategy=options["strategy"],
+            deadline_s=options["deadline_s"],
+            shrink=not options.get("no_shrink"),
+            store_dir=options.get("store_dir"),
+        )
+        results = run_chaos(cfg)
+        print(_json.dumps(results, indent=2, sort_keys=True))
+        line = report.chaos_line(results)
+        if line:
+            print(line, file=sys.stderr)
+        raise SystemExit(1 if results["found"] else 0)
+
+    return {"chaos": {
+        "opt_spec": [
+            opt("--workload", "-w", default="register",
+                help="Chaos workload (jepsen_tpu.chaos.driver"
+                     ".WORKLOADS)"),
+            opt("--ops", type=int, default=256,
+                help="Workload ops per schedule"),
+            opt("--budget", "-n", type=int, default=40,
+                help="Schedule executions (shrink re-runs included)"),
+            opt("--seed", "-s", type=int, default=45100,
+                help="Chaos seed (sampling + mutation)"),
+            opt("--strategy", default="guided",
+                choices=["guided", "random"],
+                help="guided (coverage feedback) or random "
+                     "(uniform draws, the A/B baseline)"),
+            opt("--deadline-s", type=float, default=120.0,
+                help="Per-schedule verdict deadline (the watchdog "
+                     "oracle)"),
+            opt("--no-shrink", action="store_true",
+                help="Report oracle failures unminimized"),
+            opt("--store-dir", default=None, metavar="DIR",
+                help="Write chaos.json + coverage.bin here"),
+        ],
+        "usage": "Self-chaos fault-schedule fuzzing "
+                 "(doc/robustness.md)",
+        "run": run_chaos_cmd,
+    }}
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     logging.basicConfig(level=logging.INFO)
     run({**serve_cmd(), **service_cmd(), **staticcheck_cmd(),
-         **search_cmd()}, argv)
+         **search_cmd(), **chaos_cmd()}, argv)
 
 
 if __name__ == "__main__":

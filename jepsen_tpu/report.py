@@ -174,6 +174,31 @@ def search_line(results: dict) -> str:
     return line
 
 
+def chaos_line(results: dict) -> str:
+    """One printable line summarizing a self-chaos fuzz of the
+    verification pipeline (the chaos.driver.run_chaos result shape),
+    or '' for anything else."""
+    r = results or {}
+    if not isinstance(r.get("coverage-bits"), int) \
+            or "schedules" not in r:
+        return ""
+    line = (f"chaos ({r.get('strategy', '?')}): "
+            f"{r['schedules']} schedules, "
+            f"{r['coverage-bits']} coverage bits, "
+            f"corpus {r.get('corpus-size', 0)} genomes, "
+            f"{r.get('conjunction-hits', 0)} replay-conjunction "
+            f"hit{'s' if r.get('conjunction-hits', 0) != 1 else ''}")
+    fails = r.get("failures") or []
+    if fails:
+        oracles = sorted({o for f in fails
+                          for o in (f.get("oracles") or [])})
+        line += (f"; {len(fails)} oracle failure"
+                 f"{'s' if len(fails) != 1 else ''} "
+                 f"({', '.join(oracles)}), shrunk in "
+                 f"{r.get('shrink-steps', 0)} steps")
+    return line
+
+
 @contextlib.contextmanager
 def to(filename: str, tee: bool = True):
     """Context manager: stdout inside the block is written to filename

@@ -193,6 +193,105 @@ def extract_coverage(history: Iterable[dict]) -> Coverage:
                     kgram_bits=n_kgram, adjacency_bits=n_adj)
 
 
+def _site_class(site) -> str:
+    """'stream-chunk/w0' -> 'stream-chunk': coverage is over the site
+    *kind*, not the per-stream instance name."""
+    return str(site).split("/", 1)[0]
+
+
+def _site_stream(site) -> str | None:
+    s = str(site)
+    return s.split("/", 1)[1] if "/" in s else None
+
+
+def extract_chaos_coverage(probes: Iterable[dict],
+                           actions: Iterable[str] = ()) -> Coverage:
+    """Chaos-run coverage: one pass over the pipeline's probe stream
+    (``_platform.probe``) plus the genome's scripted lifecycle actions
+    -> Coverage, reusing the search corpus machinery. Families:
+
+      cx    (fault kind x fault-site class x stream-lifecycle-state)
+            transitions — WHERE in the stream's life each fault
+            landed, the tentpole's recovery-path gradient
+      cx2   fault-during-replay conjunction: a fault/inject probe
+            inside an open replay-begin..replay-end window on the
+            same site — the path single-fault tests never reach
+      cxn   recovery-depth log2 buckets per site class (retry k sets
+            every bucket up to floor(log2 k): deeper ladders strictly
+            add bits)
+      ck    k-gram digests of the probe event sequence (bounded
+            buckets, same scheme as the history k-grams)
+      ca    scripted-action structure: each lifecycle action and each
+            adjacent action pair in schedule order
+
+    Probes are emitted synchronously from the worker thread that runs
+    the stream, so their order — and therefore the bit set — is
+    deterministic for a fixed genome."""
+    bits: set = set()
+    n_transition = n_kgram = n_action = 0
+
+    states: dict = {}        # stream name -> last lifecycle state
+    replay_open: dict = {}   # site class -> replay window open?
+    retries: dict = {}       # site class -> deepest retry seen
+    seq: list = []           # (event, detail) ordering for k-grams
+
+    def _add(b, fam):
+        nonlocal n_transition, n_kgram, n_action
+        if b not in bits:
+            bits.add(b)
+            if fam == "k":
+                n_kgram += 1
+            elif fam == "a":
+                n_action += 1
+            else:
+                n_transition += 1
+
+    for p in probes:
+        ev = p.get("event")
+        site = p.get("site", "")
+        sc = _site_class(site)
+        if ev == "lifecycle":
+            states[p.get("stream")] = p.get("state")
+            seq.append((ev, p.get("state")))
+        elif ev == "replay-begin":
+            replay_open[sc] = True
+            seq.append((ev, sc))
+        elif ev == "replay-end":
+            replay_open[sc] = False
+            seq.append((ev, sc))
+        elif ev in ("fault", "inject", "corrupt"):
+            kind = p.get("kind") or ("bitflip" if ev == "corrupt"
+                                     else None)
+            state = states.get(_site_stream(site), "admitted")
+            _add(_bit("cx", kind, sc, state), "t")
+            if replay_open.get(sc):
+                _add(_bit("cx2", kind, sc), "t")
+            if ev == "fault":
+                try:
+                    r = int(p.get("retry") or 0)
+                except (TypeError, ValueError):
+                    r = 0
+                retries[sc] = max(retries.get(sc, 0), r)
+            seq.append((ev, kind))
+        else:
+            seq.append((ev, sc))
+        if len(seq) >= KGRAM_K:
+            gram = tuple(seq[-KGRAM_K:])
+            _add(_bit("ck", _stable_bucket(("ck",) + gram,
+                                           KGRAM_SPACE)), "k")
+    for sc, deepest in retries.items():
+        for bucket in range(int(deepest).bit_length()):
+            _add(_bit("cxn", sc, bucket), "t")
+    prev = None
+    for a in actions:
+        _add(_bit("ca", a), "a")
+        if prev is not None:
+            _add(_bit("ca", prev, a), "a")
+        prev = a
+    return Coverage(bits=frozenset(bits), overlap_bits=n_transition,
+                    kgram_bits=n_kgram, adjacency_bits=n_action)
+
+
 class CoverageMap:
     """Corpus-wide accumulated coverage. add() returns the NOVEL bits
     (set difference against everything accumulated so far); encode()

@@ -94,6 +94,7 @@ class SearchConfig:
     host_budget_s: float = 2.0
     stop_on_violation: bool = True
     store_dir: Optional[str] = None
+    resume_dir: Optional[str] = None  # prior store_dir to continue from
 
     def resolved_horizon_s(self) -> float:
         if self.horizon_s is not None:
@@ -133,6 +134,45 @@ class _Search:
         self._service = None
         # genomes admitted during the previous generation (burst pool)
         self._fresh: list = []
+        if cfg.resume_dir:
+            self._resume(cfg.resume_dir)
+
+    def _resume(self, d: str) -> None:
+        """Reload a prior run's artifacts (search.json + coverage.bin)
+        and continue: the corpus, coverage map, and counters pick up
+        where the stored run left off, and the restored simulation
+        count keeps charging against max_sims — so a resumed search
+        spends only the REMAINING budget, not a fresh one.
+
+        The mutation rng restarts from cfg.seed (its walk position is
+        not persisted): a resumed search is deterministic given
+        (artifact, config), not a replay of the unsplit run."""
+        with open(os.path.join(d, "search.json")) as f:
+            art = json.load(f)
+        stored = (art.get("config") or {}).get("workload")
+        if stored is not None and stored != self.cfg.workload:
+            raise ValueError(
+                f"resume workload mismatch: {d} was searched with "
+                f"workload {stored!r}, config says "
+                f"{self.cfg.workload!r}")
+        cov_bin = os.path.join(d, "coverage.bin")
+        if os.path.exists(cov_bin):
+            with open(cov_bin, "rb") as f:
+                self.cmap = CoverageMap.decode(f.read())
+        for entry in art.get("corpus") or []:
+            g = Genome.from_dict(entry["genome"])
+            if g.key() in self._keys:
+                continue
+            self._keys.add(g.key())
+            self.corpus.append(
+                (g, int(entry.get("new-bits", 0) or 0)))
+        self.sims = int(art.get("simulations", 0) or 0)
+        self.escalations = int(art.get("escalations", 0) or 0)
+        self.shrink_steps = int(art.get("shrink-steps", 0) or 0)
+        self.generations_run = int(art.get("generations-run", 0)
+                                   or 0)
+        self.curve = list(art.get("coverage-curve") or [])
+        self.violations = list(art.get("violations") or [])
 
     # -- budget ------------------------------------------------------------
 
@@ -295,7 +335,10 @@ class _Search:
         cfg = self.cfg
         t_start = _time.monotonic()
         try:
-            for _gen in range(cfg.generations):
+            # cumulative cap: a resumed search (resume_dir) has its
+            # prior generations restored, so it runs only the
+            # remainder of the configured budget
+            while self.generations_run < cfg.generations:
                 if not self.budget_left():
                     break
                 with _M_GEN_S.time():

@@ -79,6 +79,7 @@ from typing import Callable
 
 from . import calibrate as _calibrate, reconnect as _reconnect, store, \
     telemetry as _telemetry, trace as _trace
+from ._platform import probe as _probe
 
 log = logging.getLogger(__name__)
 
@@ -184,6 +185,10 @@ TIER_NAMES = ("full", "sampled-escalation-only", "screen-only", "shed")
 DEFAULT_MAX_STREAMS = 64
 DEFAULT_QUEUE_OPS = 50_000
 DEFAULT_SHED_TIMEOUT_S = 2.0
+# idle sessions older than this are swept even while the table is
+# small — a reconnecting client past the TTL re-attaches fresh (its
+# unacked tail replays; the worker is long done, so ops drop at offer)
+SESSION_TTL_S = 600.0
 # global in-flight device budget, in select_engine-modeled element-ops
 # (~a dozen default-shape sort chunks); acquire clamps to capacity so
 # a single over-budget chunk always eventually dispatches. The budget
@@ -708,6 +713,7 @@ class StreamWorker:
         self.tier = TIER_FULL               # guarded-by: _tier_lock
         self.max_tier = TIER_FULL           # guarded-by: _tier_lock
         self.tier_transitions = 0           # guarded-by: _tier_lock
+        self._tier_frozen = False           # guarded-by: _tier_lock
         self.suspicion_score = 0.0          # guarded-by: _tier_lock
         from .checker import screen as _screen
         # deterministic per-stream sample for the sampled-escalation
@@ -742,8 +748,12 @@ class StreamWorker:
 
     def set_tier(self, tier: int, why: str) -> bool:
         """One ladder transition (idempotent). Climbing to TIER_SHED
-        sheds the stream (the pre-existing terminal rung)."""
+        sheds the stream (the pre-existing terminal rung). Refused
+        once the verdict's ladder stamp is cut (_finish): a climb
+        after that would show in status() but not in the verdict."""
         with self._tier_lock:
+            if self._tier_frozen:
+                return False
             old = self.tier
             if tier == old:
                 return False
@@ -820,6 +830,14 @@ class StreamWorker:
         if first:
             _M_EVENTS.labels(event=event).inc()
             _M_ACTIVE.dec()
+            _probe("lifecycle", stream=self.name, state=self.state,
+                   cause=event)
+            # terminal streams free their service-side residue NOW —
+            # the session token/high-water mark (no client can resume
+            # a finished stream onto a live worker) and the journal
+            # tail's poll slot (its fd would otherwise wait for the
+            # next watcher pass)
+            self.service._stream_terminal(self.name)
         self.done.set()
 
     # -- worker thread -----------------------------------------------------
@@ -839,6 +857,11 @@ class StreamWorker:
     def _release_targets(self) -> None:
         self._final_chunks = self._chunk_status()
         self._final_attest_failures = self._attest_failures()
+        # shed/quarantine can leave ops queued (only the _loop bleed
+        # branch drains them, and a quarantine raises past it): drop
+        # them here so a terminal worker never pins a full queue of
+        # op dicts for the daemon's life
+        self._bleed_queue()
         for t in self.targets.values():
             # shed/drained/quarantined streams never reach finish():
             # record their root trace spans before dropping them, or
@@ -920,6 +943,7 @@ class StreamWorker:
     def _feed(self, op: dict) -> None:
         if self.state == ADMITTED:
             self.state = STREAMING
+            _probe("lifecycle", stream=self.name, state=STREAMING)
         self.ops_fed += 1
         for name, t in self.targets.items():
             if name in self._dead_targets:
@@ -989,6 +1013,8 @@ class StreamWorker:
                     clean = False
                     self.recoveries += len(new)
                     self.state = RECOVERING
+                    _probe("lifecycle", stream=self.name,
+                           state=RECOVERING, faults=list(new))
                     if any(k == "oom" for k in new):
                         self.service.budget.note_oom()
                     # the stream re-priced itself (OOM halves its
@@ -1024,6 +1050,8 @@ class StreamWorker:
                                             seconds=dt)
             if self.state == RECOVERING:
                 self.state = STREAMING
+                _probe("lifecycle", stream=self.name, state=STREAMING,
+                       recovered=True)
 
     def _finish(self) -> None:
         # last suspicion pull before the verdict: a stream that turned
@@ -1065,11 +1093,18 @@ class StreamWorker:
             if r is not None:
                 r.setdefault("history-len", self.ops_fed)
                 out[name] = r
-        if max_tier > TIER_FULL:
-            # stamp degraded-tier verdicts so they are distinguishable
-            # from full ones. Streams that stayed at tier-full carry NO
-            # stamp: their verdicts remain byte-identical to solo runs.
-            with self._tier_lock:
+        # stamp degraded-tier verdicts so they are distinguishable
+        # from full ones. Streams that stayed at tier-full carry NO
+        # stamp: their verdicts remain byte-identical to solo runs.
+        # Re-read max_tier here, NOT the pre-pump snapshot: the
+        # controller can climb this stream while finish() pumps its
+        # pending chunks, and status() would then report a max-tier
+        # the verdict didn't carry. Freezing the tier under the same
+        # lock closes the other half of that race (a climb between
+        # this stamp and done.set()).
+        with self._tier_lock:
+            self._tier_frozen = True
+            if self.max_tier > TIER_FULL:
                 out["ladder"] = {
                     "tier": TIER_NAMES[self.tier],
                     "max-tier": TIER_NAMES[self.max_tier],
@@ -1275,7 +1310,7 @@ class _Session:
     exactly-once application. Every field is guarded by the service's
     ``_session_lock`` (the table's own lock — see __init__)."""
 
-    __slots__ = ("token", "seq", "replays", "journal_fed")
+    __slots__ = ("token", "seq", "replays", "journal_fed", "touched")
 
     def __init__(self, token: str, journal_fed: bool = False):
         self.token = token          # the client's opaque identity
@@ -1284,6 +1319,8 @@ class _Session:
         # a journal-fed stream is driven by the store tail (recover or
         # watch); socket ops would double-apply and are dropped
         self.journal_fed = journal_fed
+        # last attach/apply (monotonic) — the TTL sweep's idle clock
+        self.touched = _time.monotonic()
 
 
 class VerificationService:
@@ -1299,7 +1336,8 @@ class VerificationService:
                  adaptive: bool = True,
                  ladder_tick_s: float = LADDER_TICK_S,
                  ladder_climb_hold_s: float = LADDER_CLIMB_HOLD_S,
-                 ladder_descend_hold_s: float = LADDER_DESCEND_HOLD_S):
+                 ladder_descend_hold_s: float = LADDER_DESCEND_HOLD_S,
+                 session_ttl_s: float = SESSION_TTL_S):
         self.max_streams = max_streams
         self.queue_ops = queue_ops
         self.shed_timeout_s = shed_timeout_s
@@ -1351,6 +1389,7 @@ class VerificationService:
         # nested inside it (the JTS202 order discipline).
         self._session_lock = threading.Lock()
         self._sessions: dict[str, _Session] = {}  # guarded-by: _session_lock
+        self.session_ttl_s = float(session_ttl_s)
         # -- crash consistency / replica failover state. claim_store
         # runs before any worker exists (single-threaded start or
         # standby promotion), so epoch/store_root need no lock; _fenced
@@ -1740,6 +1779,7 @@ class VerificationService:
             if s.token == token:
                 if journal_fed:
                     s.journal_fed = True
+                s.touched = _time.monotonic()
                 return s
             return None
 
@@ -1764,6 +1804,7 @@ class VerificationService:
                 return True     # attached without a session handshake
             if s.journal_fed:
                 return False
+            s.touched = _time.monotonic()
             if seq <= s.seq:
                 s.replays += 1
                 _M_REPLAYS.inc()
@@ -1783,16 +1824,44 @@ class VerificationService:
             s = self._sessions.get(stream) if stream else None
             return bool(s and s.journal_fed)
 
-    def _prune_sessions(self) -> None:
-        """Bound the session table: entries whose stream left the
-        worker table are dead (no client can re-attach them onto a
-        live worker). Locks taken sequentially, never nested."""
-        with self._lock:
-            live = set(self.workers)
+    def _stream_terminal(self, name: str) -> None:
+        """A worker reached a terminal state (verdict / shed /
+        quarantined / drained): evict its session entry and journal
+        tail right away instead of waiting for the size-gated prune or
+        the next watcher pass. Locks taken sequentially, never
+        nested (and never while holding the worker's _term_lock —
+        _terminal releases it before calling here)."""
         with self._session_lock:
+            self._sessions.pop(name, None)
+        with self._lock:
+            stale = [d for d, (_t, n) in self._tails.items()
+                     if n == name]
+            for d in stale:
+                tail, _n = self._tails.pop(d)
+                self._finished_dirs.add(d)
+                tail.close()
+
+    def _prune_sessions(self) -> None:
+        """Bound the session table. Terminal streams already evicted
+        their entries (_stream_terminal); this sweep covers the rest:
+        sessions idle past the TTL with no live worker (a client that
+        attached, went away, and never drove its stream to a verdict),
+        plus a size-gated prune of anything not in the worker table as
+        a backstop. Locks taken sequentially, never nested."""
+        now = _time.monotonic()
+        with self._lock:
+            live = {n for n, w in self.workers.items()
+                    if not w.done.is_set()}
+            known = set(self.workers)
+        with self._session_lock:
+            if self.session_ttl_s > 0:
+                for n in [n for n, s in self._sessions.items()
+                          if n not in live
+                          and now - s.touched > self.session_ttl_s]:
+                    del self._sessions[n]
             if len(self._sessions) <= max(256, 4 * self.keep_done):
                 return
-            for n in [n for n in self._sessions if n not in live]:
+            for n in [n for n in self._sessions if n not in known]:
                 del self._sessions[n]
 
     # -- store watching ----------------------------------------------------
@@ -1894,6 +1963,7 @@ class VerificationService:
                     with self._lock:
                         self._tails.pop(d, None)
                         self._finished_dirs.add(d)
+                    tail.close()
                     continue
                 if tail.idle_s > 0 and now < getattr(
                         tail, "_next_poll", 0.0):
@@ -1905,6 +1975,7 @@ class VerificationService:
                     w._quarantine(traceback.format_exc())
                     with self._lock:
                         self._tails.pop(d, None)
+                    tail.close()
                     continue
                 for op in ops:
                     w.offer(op, self.shed_timeout_s)
@@ -1915,6 +1986,7 @@ class VerificationService:
                     w.seal()
                     with self._lock:
                         self._tails.pop(d, None)
+                    tail.close()
                     continue
                 # decorrelated-jitter idle backoff (satellite): quiet
                 # journals get polled less and less, any data resets
@@ -2002,18 +2074,42 @@ class VerificationService:
         stop watching."""
         self._watch_stop.set()
         self._ladder_stop.set()
-        if self._server is not None:
+        srv, self._server = self._server, None
+        if srv is not None:
+            # closing the fd does NOT interrupt a thread blocked in
+            # accept() on Linux — poke the listener with a throwaway
+            # connect so the accept loop wakes, sees _server is None,
+            # and exits (the chaos resource-leak oracle counts the
+            # thread otherwise)
             try:
-                self._server.close()
+                with _socket.socket(srv.family,
+                                    _socket.SOCK_STREAM) as poke:
+                    poke.settimeout(0.2)
+                    poke.connect(srv.getsockname())
             except OSError:
                 pass
-            self._server = None
+            try:
+                srv.close()
+            except OSError:
+                pass
+            for t in self._server_threads:
+                t.join(timeout=1.0)
+            self._server_threads.clear()
 
     def _accept_loop(self) -> None:
-        while self._server is not None:
+        while True:
+            srv = self._server
+            if srv is None:
+                return
             try:
-                conn, _ = self._server.accept()
+                conn, _ = srv.accept()
             except OSError:
+                return
+            if self._server is None:   # stop()'s wake-up poke
+                try:
+                    conn.close()
+                except OSError:
+                    pass
                 return
             # daemon thread per connection, deliberately NOT retained:
             # a serving daemon sees one connection per run, and an

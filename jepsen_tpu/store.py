@@ -313,6 +313,18 @@ class JournalTail:
         self._idle_cap_s = idle_cap_s
         self._rng = rng
         self._delays = None
+        self._closed = False
+
+    def close(self) -> None:
+        """Retire the tail: drop the torn-line buffer and make every
+        later poll() a no-op. poll() opens the journal per call (no
+        persistent fd to leak), so close() exists for the CONSUMER
+        side — a service dropping a finished run's tail must not race
+        a concurrent poller into re-feeding buffered ops."""
+        self._closed = True
+        self._buf = ""
+        self._delays = None
+        self.idle_s = self._idle_cap_s
 
     def _note_idle(self, active: bool) -> None:
         if active:
@@ -326,6 +338,8 @@ class JournalTail:
         self.idle_s = next(self._delays)
 
     def poll(self) -> list[dict]:
+        if self._closed:
+            return []
         try:
             with open(self.path) as fh:
                 fh.seek(self._pos)
@@ -387,8 +401,17 @@ def write_streamed_results(run_dir: str, results: dict) -> str:
     an in-process online run would have stashed."""
     os.makedirs(run_dir, exist_ok=True)
     p = os.path.join(run_dir, STREAMED_RESULTS_FILE)
-    with open(p, "w") as fh:
+    # tmp-then-rename (the write_service_resume idiom): this file's
+    # very EXISTENCE means "verdict delivered" to recover()'s orphan
+    # scan and to concurrent pollers — a torn write would read as an
+    # empty verdict (found by the chaos harness's verdict poller
+    # racing a shed's deferred flush)
+    tmp = f"{p}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
         json.dump(results, fh, indent=2, default=_json_default)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, p)
     return p
 
 

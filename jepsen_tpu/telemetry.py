@@ -50,7 +50,7 @@ from typing import Callable, Iterable
 # enforces this over every registered metric; keep the sets in sync
 # with the doc catalog in doc/observability.md)
 LAYERS = ("wgl", "streaming", "screen", "abft", "service", "trace",
-          "run", "web", "search")
+          "run", "web", "search", "chaos")
 UNITS = ("total", "seconds", "rows", "ops", "chunks", "elementops",
          "bytes", "ratio", "streams", "info", "bits", "genomes")
 

@@ -1,0 +1,450 @@
+"""Self-chaos harness (ISSUE 20): fault schedules, chaos genomes,
+oracles, the guided-vs-random A/B, and the back-to-back fault pins.
+
+The headline pins:
+  * at a fixed seed and budget, the coverage-guided search reaches the
+    fault-DURING-recovery-replay conjunction that pure-random sampling
+    misses — the compound failure path the harness exists for;
+  * on the clean tree every oracle stays green across both arms;
+  * a mutation test (the recovery replay rung silently skipped) is
+    caught by the verdict-identity oracle and the failing schedule
+    shrinks to <= 3 events.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import _platform, models, service, store
+from jepsen_tpu.chaos import (ChaosConfig, ChaosEvent, ChaosGenome,
+                              run_chaos)
+from jepsen_tpu.chaos import genome as genome_mod
+from jepsen_tpu.chaos import oracles as oracles_mod
+from jepsen_tpu.chaos.driver import _Chaos, replay_conjunction
+from jepsen_tpu.checker import streaming, synth
+from jepsen_tpu.search.coverage import extract_chaos_coverage
+
+MODEL = models.cas_register()
+CHUNK = 64
+SLOTS = 8
+FRONTIER = 128
+CKPT = 2
+TIMING = ("tail-latency-ms", "duration-ms", "violation-at-op")
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_injection():
+    _platform.reset_fault_injection()
+    yield
+    _platform.reset_fault_injection()
+
+
+def _canon(x):
+    return json.loads(json.dumps(x, default=store._json_default,
+                                 sort_keys=True))
+
+
+def _strip(d, extra=()):
+    return _canon({k: v for k, v in d.items()
+                   if k not in TIMING + tuple(extra)})
+
+
+def _jops(h):
+    return [json.loads(json.dumps(op, default=store._json_default))
+            for op in h.ops]
+
+
+def _solo(ops, **kw):
+    s = streaming.WglStream(MODEL, chunk_entries=CHUNK, slots=SLOTS,
+                            frontier=FRONTIER, checkpoint_every=CKPT,
+                            **kw)
+    for op in ops:
+        s.feed(op)
+    return s.finish()
+
+
+def _wgl_spec(**over):
+    sp = {"kind": "wgl", "model": service.model_spec(MODEL),
+          "chunk-entries": CHUNK, "slots": SLOTS, "engine": "sort",
+          "frontier": FRONTIER, "checkpoint-every": CKPT}
+    sp.update(over)
+    return sp
+
+
+# -- _platform.FaultSchedule ------------------------------------------------
+
+def test_schedule_relative_triggers_fire_in_order():
+    """Event i+1 arms only after event i fires: oom at dispatch hit 2,
+    then compile 1 hit AFTER that — hits 1..4 inject at 2 and 3."""
+    sched = _platform.FaultSchedule([
+        _platform.FaultEvent("oom", "s/*", 2),
+        _platform.FaultEvent("compile", "s/*", 1)])
+    _platform.install_fault_schedule(sched)
+    kinds = []
+    for _ in range(4):
+        try:
+            _platform.maybe_inject_fault("s/a")
+            kinds.append(None)
+        except _platform.InjectedFault as e:
+            kinds.append(e.kind)
+    assert kinds == [None, "oom", "compile", None]
+    assert [k for (k, _s, _a) in sched.fired] == ["oom", "compile"]
+
+
+def test_schedule_bitflip_consumes_staging_hits_only():
+    import numpy as np
+    sched = _platform.FaultSchedule([
+        _platform.FaultEvent("bitflip", "s/*", 2)])
+    _platform.install_fault_schedule(sched)
+    a = np.zeros((4, 4), np.int32)
+    # dispatch hits do not advance a bitflip event
+    for _ in range(5):
+        _platform.maybe_inject_fault("s/a")
+    assert not sched.fired
+    assert _platform.maybe_corrupt("s/a", a) is a
+    flipped = _platform.maybe_corrupt("s/a", a)
+    assert flipped is not a and (flipped != a).sum() == 1
+    assert [k for (k, _s, _a) in sched.fired] == ["bitflip"]
+
+
+def test_schedule_site_pattern_and_from_clauses():
+    sched = _platform.FaultSchedule.from_clauses(["oom@s/a:1"])
+    _platform.install_fault_schedule(sched)
+    _platform.maybe_inject_fault("s/b")      # pattern miss: no fire
+    with pytest.raises(_platform.InjectedFault):
+        _platform.maybe_inject_fault("s/a")
+    assert sched.fired == [("oom", "s/a", 1)]
+
+
+def test_schedule_cleared_by_reset():
+    _platform.install_fault_schedule(_platform.FaultSchedule(
+        [_platform.FaultEvent("oom", "*", 1)]))
+    _platform.reset_fault_injection()
+    assert _platform.current_fault_schedule() is None
+    _platform.maybe_inject_fault("s/a")      # nothing installed
+
+
+def test_env_clause_still_injects(monkeypatch):
+    """The env form stays back-compatible alongside schedules."""
+    monkeypatch.setenv(_platform.FAULT_INJECT_ENV, "oom@s/a:2")
+    _platform.reset_fault_injection()
+    _platform.maybe_inject_fault("s/a")
+    with pytest.raises(_platform.InjectedFault):
+        _platform.maybe_inject_fault("s/a")
+
+
+# -- genomes ----------------------------------------------------------------
+
+def test_genome_json_round_trip_preserves_order():
+    g = ChaosGenome(seed=9, workload="register", ops=128, events=(
+        ChaosEvent("oom", 2), ChaosEvent("kill-recover", 40),
+        ChaosEvent("bitflip", 1)))
+    g2 = ChaosGenome.from_dict(json.loads(json.dumps(g.to_dict())))
+    assert g2 == g and g2.key() == g.key()
+    swapped = ChaosGenome.from_dict({**g.to_dict(), "events": list(
+        reversed(g.to_dict()["events"]))})
+    assert swapped.key() != g.key()
+
+
+def test_mutators_stay_in_bounds():
+    rng = random.Random(7)
+    g = genome_mod.sample_genome(rng, "register", 128)
+    for _ in range(300):
+        g = genome_mod.mutate(g, rng)
+        assert 1 <= len(g.events) <= genome_mod.MAX_EVENTS
+        for e in g.events:
+            if e.lifecycle:
+                assert 0 <= e.at < g.ops
+                assert e.kind in genome_mod.LIFECYCLE_KINDS
+            else:
+                assert 1 <= e.at <= genome_mod.MAX_AFTER
+                assert e.kind in genome_mod.BACKEND_KINDS
+
+
+def test_shrink_reductions_strictly_smaller():
+    g = ChaosGenome(seed=9, workload="register", ops=256, events=(
+        ChaosEvent("oom", 8), ChaosEvent("compile", 4)))
+    cands = list(genome_mod.shrink_reductions(g))
+    assert cands
+    for c in cands:
+        assert genome_mod.genome_size(c) < genome_mod.genome_size(g)
+
+
+# -- oracles ----------------------------------------------------------------
+
+def _outcome(**kw):
+    base = {"timed-out": False, "deferred": False, "degraded": False,
+            "fired": [], "actions": [], "deadline-s": 60.0}
+    base.update(kw)
+    return base
+
+
+def test_oracle_verdict_identity_catches_divergence():
+    solo = {"valid?": True, "frontier-max": 3, "duration-ms": 9}
+    good = {"valid?": True, "frontier-max": 3, "duration-ms": 12,
+            "recovered": {"faults": ["oom"], "retries": 1}}
+    bad = {"valid?": True, "frontier-max": 4}
+    fired = [("oom", "s", 1)]
+    assert not oracles_mod.check_oracles(
+        {"linear": solo},
+        _outcome(results={"linear": good}, fired=fired))
+    fails = oracles_mod.check_oracles(
+        {"linear": solo},
+        _outcome(results={"linear": bad}, fired=fired))
+    assert any(f["oracle"] == "verdict-identity" for f in fails)
+
+
+def test_oracle_violation_missed_is_unconditional():
+    solo = {"valid?": False, "frontier-max": 3}
+    fails = oracles_mod.check_oracles(
+        {"linear": solo},
+        _outcome(results={"linear": {"valid?": True}}, degraded=True,
+                 fired=[("oom", "s", 1)]))
+    assert any(f["oracle"] == "violation-missed" for f in fails)
+
+
+def test_oracle_stamp_rules():
+    solo = {"valid?": True}
+    # fired fault, no recovered stamp -> inconsistent
+    fails = oracles_mod.check_oracles(
+        {"linear": solo},
+        _outcome(results={"linear": {"valid?": True}},
+                 fired=[("oom", "s", 1)]))
+    assert any(f["oracle"] == "stamp-consistency" for f in fails)
+    # ... unless a promotion raced the schedule
+    assert not oracles_mod.check_oracles(
+        {"linear": solo},
+        _outcome(results={"linear": {"valid?": True}},
+                 fired=[("oom", "s", 1)], actions=["kill-recover"]))
+    # nothing injected, no verdict -> inconsistent
+    fails = oracles_mod.check_oracles(
+        {"linear": solo}, _outcome(results=None, deferred=True))
+    assert any(f["oracle"] == "stamp-consistency" for f in fails)
+
+
+def test_oracle_watchdog_and_resources():
+    solo = {"valid?": True}
+    fails = oracles_mod.check_oracles(
+        {"linear": solo}, _outcome(results=None, **{"timed-out": True}),
+        {"fds-before": 8, "fds-after": 9,
+         "threads-before": 2, "threads-after": 2})
+    got = {f["oracle"] for f in fails}
+    assert "watchdog" in got and "resource-leak" in got
+
+
+# -- coverage ---------------------------------------------------------------
+
+def test_chaos_coverage_distinguishes_replay_conjunction():
+    plain = [{"event": "fault", "site": "stream-chunk/t", "kind": "oom",
+              "retry": 1}]
+    conj = [{"event": "fault", "site": "stream-chunk/t", "kind": "oom",
+             "retry": 1},
+            {"event": "replay-begin", "site": "stream-chunk/t",
+             "from_chunk": 2},
+            {"event": "fault", "site": "stream-chunk/t",
+             "kind": "compile", "retry": 2}]
+    c_plain = extract_chaos_coverage(plain)
+    c_conj = extract_chaos_coverage(conj)
+    assert c_conj.bits - c_plain.bits
+    assert c_conj.overlap_bits > c_plain.overlap_bits
+    assert not replay_conjunction(plain)
+    assert replay_conjunction(conj)
+    closed = conj + [{"event": "replay-end",
+                      "site": "stream-chunk/t", "replayed": 64}]
+    assert replay_conjunction(closed)   # the hit already landed
+
+
+# -- back-to-back faults against the live checker (satellite) ---------------
+
+def _hist(seed, n=300):
+    return _jops(synth.register_history(n, concurrency=3, values=5,
+                                        seed=seed))
+
+
+@pytest.mark.slow
+def test_fault_during_recovery_replay_resumes_correctly():
+    """The conjunction itself, pinned solo: a second fault lands
+    inside the first fault's recovery replay (relative trigger 1) and
+    the stream STILL converges to the uninjected verdict."""
+    ops = _hist(61)
+    want = _solo(ops)
+    probes = []
+    _platform.probe_hook = probes.append
+    try:
+        _platform.install_fault_schedule(_platform.FaultSchedule([
+            _platform.FaultEvent("oom", "stream-chunk", 3),
+            _platform.FaultEvent("compile", "stream-chunk", 1)]))
+        got = _solo(ops)
+    finally:
+        _platform.probe_hook = None
+    assert replay_conjunction(probes), \
+        "schedule did not land the second fault inside the replay"
+    assert sorted(got["recovered"]["faults"]) == ["compile", "oom"]
+    assert _strip(got, ("recovered", "attested")) == \
+        _strip(want, ("recovered", "attested"))
+
+
+@pytest.mark.slow
+def test_fault_at_chunk_zero_cold():
+    """First-ever dispatch faults: recovery has no checkpoint to
+    restore and replays from nothing — still byte-identical."""
+    ops = _hist(62)
+    want = _solo(ops)
+    _platform.install_fault_schedule(_platform.FaultSchedule([
+        _platform.FaultEvent("device-lost", "stream-chunk", 1)]))
+    got = _solo(ops)
+    assert got["recovered"]["faults"] == ["device-lost"]
+    assert _strip(got, ("recovered", "attested")) == \
+        _strip(want, ("recovered", "attested"))
+
+
+@pytest.mark.slow
+def test_corrupt_manifest_then_fault_during_recover(tmp_path):
+    """recover() meets a corrupt resume.json AND a backend fault
+    during the cold re-check — resumed-or-honestly-degraded, never
+    wrong."""
+    ops = _hist(63)
+    want = _solo(ops)
+    root = str(tmp_path / "st")
+    d = os.path.join(root, "t", "0")
+    os.makedirs(d)
+    with open(os.path.join(d, "journal.jsonl"), "w") as fh:
+        for op in ops:
+            fh.write(json.dumps(op, default=store._json_default)
+                     + "\n")
+    import gzip
+    with gzip.open(os.path.join(d, "history.jsonl.gz"), "wt") as fh:
+        for op in ops:
+            fh.write(json.dumps(op, default=store._json_default)
+                     + "\n")
+    svcdir = os.path.join(d, "service")
+    os.makedirs(svcdir)
+    with open(os.path.join(svcdir, "resume.json"), "w") as fh:
+        fh.write('{"stream": "t/0", "targets": {"linear"')
+    assert store.load_service_resume(d) is None
+
+    _platform.install_fault_schedule(_platform.FaultSchedule([
+        _platform.FaultEvent("oom", "stream-chunk/t/0", 2)]))
+    svc = service.VerificationService(adaptive=False)
+    try:
+        names = svc.recover(
+            root, spec_fn=lambda _d: {"linear": _wgl_spec()})
+        assert names == ["t/0"]
+        w = svc._worker("t/0")
+        assert w.done.wait(120.0)
+        got = dict(w.results)
+        if not got:
+            got = store.load_streamed_results(d) or {}
+        sched = _platform.current_fault_schedule()
+        assert [k for (k, _s, _a) in sched.fired] == ["oom"]
+        assert _strip(got["linear"], ("recovered", "attested")) == \
+            _strip(want, ("recovered", "attested"))
+    finally:
+        svc.stop()
+
+
+# -- the loop: clean-tree green, A/B separation, mutation test --------------
+
+@pytest.mark.slow
+def test_clean_tree_all_oracles_green():
+    r = run_chaos(ChaosConfig(budget=8, seed=5, ops=128,
+                              strategy="guided"))
+    assert r["schedules"] == 8
+    assert r["failures"] == [] and not r["found"]
+    assert r["coverage-bits"] > 0
+
+
+@pytest.mark.slow
+def test_guided_vs_random_replay_conjunction_pin():
+    """The A/B the harness exists for, at a pinned (seed, budget):
+    guided constructs the fault-during-replay conjunction; random,
+    drawing from the same event space, never does."""
+    guided = run_chaos(ChaosConfig(budget=30, seed=23, ops=128,
+                                   strategy="guided"))
+    rand = run_chaos(ChaosConfig(budget=30, seed=23, ops=128,
+                                 strategy="random"))
+    assert guided["failures"] == [] and rand["failures"] == []
+    assert guided["found-conjunction"], \
+        "guided search no longer reaches the replay conjunction"
+    assert guided["conjunction-hits"] >= 3
+    assert rand["conjunction-hits"] == 0, \
+        "random found the conjunction — the pin lost its separation"
+    assert guided["corpus-size"] > 0 and rand["corpus-size"] == 0
+
+
+@pytest.mark.slow
+def test_mutation_skipped_replay_rung_caught_and_shrunk(monkeypatch):
+    """Mutation test: silently skip the recovery replay rung (restore
+    the checkpoint, never replay the steps-log tail). The
+    verdict-identity oracle must catch it and the failing schedule
+    must shrink to <= 3 events."""
+    orig = streaming.WglStream._restore_and_replay
+
+    def skip_replay(self):
+        saved = self._steps_log
+        rows0 = self._ckpt[0] if self._ckpt is not None else 0
+        kept, got = [], 0
+        for a in saved:
+            if got + len(a) <= rows0:
+                kept.append(a)
+                got += len(a)
+            elif got < rows0:
+                kept.append(a[:rows0 - got])
+                got = rows0
+            else:
+                break
+        self._steps_log = kept
+        try:
+            return orig(self)
+        finally:
+            self._steps_log = saved
+
+    monkeypatch.setattr(streaming.WglStream, "_restore_and_replay",
+                        skip_replay)
+    cfg = ChaosConfig(budget=60, seed=3, ops=256,
+                      workload="register-corrupt")
+    c = _Chaos(cfg)
+    g = ChaosGenome(seed=5, workload="register-corrupt", ops=256,
+                    events=(ChaosEvent("oom", 2),
+                            ChaosEvent("bitflip", 1),
+                            ChaosEvent("device-lost", 17)))
+    out = c.run_schedule(g)
+    assert any(f["oracle"] == "verdict-identity"
+               for f in out["failures"]), \
+        "broken replay rung not caught by the byte-identity oracle"
+    c._record_failure(g, out)
+    minimized = c.failures[0]["minimized"]
+    assert len(minimized["events"]) <= 3
+    assert c.shrink_steps > 0
+
+
+@pytest.mark.slow
+def test_artifacts_round_trip(tmp_path):
+    d = str(tmp_path / "art")
+    r = run_chaos(ChaosConfig(budget=6, seed=5, ops=128,
+                              store_dir=d))
+    art = json.load(open(os.path.join(d, "chaos.json")))
+    assert art["coverage-digest"] == r["coverage-digest"]
+    for entry in art["corpus"]:
+        ChaosGenome.from_dict(entry["genome"])   # round-trips
+    from jepsen_tpu.search.coverage import CoverageMap
+    with open(os.path.join(d, "coverage.bin"), "rb") as f:
+        cmap = CoverageMap.decode(f.read())
+    assert len(cmap) == r["coverage-bits"]
+
+
+@pytest.mark.slow
+def test_no_thread_growth_across_schedules():
+    """The harness's own hygiene: a burst of lifecycle-heavy schedules
+    leaves no worker/watcher/server threads behind (the resource-leak
+    oracle enforces per-run; this pins the aggregate)."""
+    before = threading.active_count()
+    r = run_chaos(ChaosConfig(budget=6, seed=13, ops=128,
+                              lifecycle_p=0.9, strategy="random"))
+    assert r["failures"] == []
+    assert threading.active_count() <= before

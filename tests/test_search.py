@@ -503,3 +503,36 @@ def test_cli_search_rejects_unknown_workload(capsys):
     with pytest.raises(SystemExit) as ei:
         cli.main(["search", "--workload", "bogus"])
     assert ei.value.code == 254
+
+
+def test_search_resume_continues_under_remaining_budget(tmp_path):
+    """ISSUE 20 satellite: --resume reloads search.json +
+    coverage.bin and continues — restored sims keep charging against
+    max_sims, the corpus and coverage map carry over, and the
+    generation budget is cumulative."""
+    d = str(tmp_path / "out")
+    first = run_search(SearchConfig(
+        workers=2, store_dir=d, workload="phased-register",
+        strategy="guided", bug="lost-write-kill-partition",
+        generations=2, population=10, seed=2, max_sims=60,
+        escalate="none"))
+    assert first["generations-run"] == 2
+    resumed = run_search(SearchConfig(
+        workers=2, store_dir=d, resume_dir=d,
+        workload="phased-register", strategy="guided",
+        bug="lost-write-kill-partition", generations=5,
+        population=10, seed=3, max_sims=60, escalate="none"))
+    # continued, not restarted
+    assert resumed["simulations"] > first["simulations"]
+    assert resumed["generations-run"] > first["generations-run"]
+    assert resumed["simulations"] <= 60
+    assert resumed["coverage-bits"] >= first["coverage-bits"]
+    assert resumed["corpus-size"] >= first["corpus-size"]
+    assert resumed["coverage-curve"][:len(first["coverage-curve"])] \
+        == first["coverage-curve"]
+    # artifacts rewritten in place reflect the continued run
+    art = json.loads((tmp_path / "out" / "search.json").read_text())
+    assert art["simulations"] == resumed["simulations"]
+    # a workload mismatch is refused before any simulation
+    with pytest.raises(ValueError, match="resume workload"):
+        run_search(SearchConfig(workload="register", resume_dir=d))

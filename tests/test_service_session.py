@@ -422,3 +422,68 @@ def test_protocol_fuzz_daemon_stays_healthy(served):
     assert r["ok"], r
     assert _strip(r["results"]["linear"]) == _strip(solo)
     sib.close()
+
+
+# -- bounded session table (ISSUE 20 satellite) -----------------------------
+
+def test_terminal_stream_evicts_session(served):
+    """A stream reaching its verdict frees its session entry
+    immediately — no client can resume a finished stream onto a live
+    worker, so keeping the token + high-water mark is pure growth."""
+    svc, addr = served
+    ops, solo = _hist(61)
+    c = _Raw(addr)
+    r = c.request({"type": "attach", "stream": "evict/1",
+                   "targets": {"linear": _wgl_spec()},
+                   "session": "tok-e"})
+    assert r["ok"]
+    for seq, op in enumerate(ops, 1):
+        c.send({"type": "op", "op": op, "seq": seq})
+    with svc._session_lock:
+        assert "evict/1" in svc._sessions
+    r = c.request({"type": "finish", "timeout-s": 300})
+    assert r["ok"]
+    assert _strip(r["results"]["linear"]) == _strip(solo)
+    with svc._session_lock:
+        assert "evict/1" not in svc._sessions
+    c.close()
+
+
+def test_session_ttl_sweep():
+    """Sessions idle past the TTL with no live worker are swept;
+    a session whose stream is still streaming survives any idle."""
+    svc = service.VerificationService(adaptive=False,
+                                      session_ttl_s=0.05)
+    try:
+        ops, _ = _hist(61)
+        # a ghost session: its stream never had a worker (the client
+        # died between attach and first op)
+        assert svc._session_attach("ghost/1", "tok-g", False)
+        # a live one: worker admitted and not done
+        svc.admit("live/1", {"linear": _wgl_spec()})
+        assert svc._session_attach("live/1", "tok-l", False)
+        svc.offer("live/1", ops[0])
+        time.sleep(0.1)
+        svc._prune_sessions()
+        with svc._session_lock:
+            assert "ghost/1" not in svc._sessions
+            assert "live/1" in svc._sessions
+        svc.seal("live/1")
+        assert svc._worker("live/1").done.wait(60.0)
+    finally:
+        svc.stop()
+
+
+def test_session_table_size_backstop():
+    """Even inside the TTL, the table cannot grow unboundedly: past
+    the size gate, entries with no known worker are dropped."""
+    svc = service.VerificationService(adaptive=False)
+    try:
+        n = max(256, 4 * svc.keep_done) + 10
+        for i in range(n):
+            svc._session_attach(f"g/{i}", f"tok-{i}", False)
+        svc._prune_sessions()
+        with svc._session_lock:
+            assert len(svc._sessions) <= max(256, 4 * svc.keep_done)
+    finally:
+        svc.stop()

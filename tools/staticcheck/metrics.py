@@ -35,6 +35,7 @@ MODULES = (
     "jepsen_tpu.service",
     "jepsen_tpu.web",
     "jepsen_tpu.search.driver",
+    "jepsen_tpu.chaos.driver",
 )
 
 REGISTRY_PATH = "<metrics-registry>"
